@@ -1,0 +1,17 @@
+"""rafting_tpu — a TPU-native Multi-Raft consensus framework.
+
+A brand-new implementation of the capabilities of curioloop/rafting (Java:
+AppendEntries, RequestVote, PreVote, InstallSnapshot, replicated durable
+logs, snapshot/compaction lifecycle, pluggable state machines, Multi-Raft
+group management), re-designed for TPUs: the consensus state of up to 100k
+Raft groups lives in group-major JAX arrays in HBM and one jitted step
+advances every group at once.
+"""
+
+__version__ = "0.1.0"
+
+from .core import (  # noqa: F401
+    CANDIDATE, FOLLOWER, LEADER, NIL, PRE_CANDIDATE,
+    DeviceCluster, EngineConfig, HostInbox, Messages, RaftState, StepInfo,
+    cluster_step, init_state, node_step,
+)

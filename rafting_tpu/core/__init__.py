@@ -1,0 +1,15 @@
+from .types import (
+    CANDIDATE, FOLLOWER, LEADER, NIL, PRE_CANDIDATE,
+    EngineConfig, HostInbox, LogState, Messages, RaftState, StepInfo,
+    init_state,
+)
+from .step import node_step, ring_term_at, ring_terms_batch, ring_write_batch
+from .cluster import DeviceCluster, cluster_step, route, auto_host_inbox
+
+__all__ = [
+    "CANDIDATE", "FOLLOWER", "LEADER", "NIL", "PRE_CANDIDATE",
+    "EngineConfig", "HostInbox", "LogState", "Messages", "RaftState",
+    "StepInfo", "init_state", "node_step", "ring_term_at",
+    "ring_terms_batch", "ring_write_batch", "DeviceCluster", "cluster_step",
+    "route", "auto_host_inbox",
+]

@@ -1,0 +1,199 @@
+"""Whole-cluster execution: N vectorized nodes in one SPMD program.
+
+The reference runs one JVM per node and moves RPCs over per-peer TCP
+connections (transport/EventBus.java, transport/EventNode.java).  Here an
+entire N-node cluster is ``vmap(node_step)`` over a leading node axis, and
+message routing is a pure array permutation: ``inbox[dst, src] =
+outbox[src, dst]`` — a transpose of the first two axes.  Under a
+``Mesh('node', 'group')`` sharding, that transpose lowers to an XLA
+all-to-all over ICI, which is exactly the multi-chip deployment story: one
+Raft node per device, consensus traffic riding the interconnect.
+
+Fault injection (network partitions, message drops) is a boolean
+connectivity matrix ANDed into every ``*_valid`` mask — the vectorized
+analog of killing TCP links in the reference's manual chaos procedure
+(README.md:28-33).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .step import node_step, ring_term_at
+from .types import (
+    LEADER, EngineConfig, HostInbox, Messages, RaftState, StepInfo, init_state,
+)
+
+_VALID_FIELDS = tuple(f.name for f in dataclasses.fields(Messages)
+                      if f.name.endswith("_valid"))
+
+
+def route(outboxes: Messages, conn: Optional[jax.Array] = None) -> Messages:
+    """Deliver every node's outbox as next tick's inboxes.
+
+    ``outboxes`` arrays are [N, P, G, ...] with axis 0 = sender, axis 1 =
+    destination; the delivered inboxes are [N, P, G, ...] with axis 0 =
+    destination, axis 1 = sender — a pure transpose.  ``conn[s, d]`` masks
+    link s->d (False = partitioned / dropped).
+    """
+    swapped = jax.tree.map(lambda a: jnp.swapaxes(a, 0, 1), outboxes)
+    if conn is None:
+        return swapped
+    # After the swap an element at [d, s] traveled s->d: mask with conn.T.
+    mask = jnp.swapaxes(conn, 0, 1)
+    reps = {}
+    for name in _VALID_FIELDS:
+        arr = getattr(swapped, name)
+        reps[name] = arr & mask[..., None]
+    return swapped.replace(**reps)
+
+
+@partial(jax.jit, static_argnums=0, donate_argnums=(1, 2))
+def cluster_step(cfg: EngineConfig, states: RaftState, inflight: Messages,
+                 host: HostInbox, conn: jax.Array
+                 ) -> Tuple[RaftState, Messages, StepInfo]:
+    """One lockstep tick of the whole cluster.
+
+    ``states``/``host``/returned ``StepInfo`` carry a leading node axis [N];
+    ``inflight`` is the messages currently traveling (delivered this tick).
+    """
+    inboxes = route(inflight, conn)
+    new_states, outboxes, infos = jax.vmap(partial(node_step, cfg))(
+        states, inboxes, host)
+    return new_states, outboxes, infos
+
+
+@partial(jax.jit, static_argnums=(0, 3))
+def auto_host_inbox(cfg: EngineConfig, states: RaftState, submit_n: jax.Array,
+                    compact: bool, prev_info: StepInfo) -> HostInbox:
+    """Build a HostInbox batch [N, ...] for the self-driving harness.
+
+    Policy (the steady-state behavior of a host runtime whose state machines
+    keep pace — reference MaintainAgreement, command/MaintainAgreement.java):
+
+    * offer ``submit_n`` client commands per group (leaders accept);
+    * compact with slack: raise the log floor only up to ``commit - L/4``,
+      keeping a tail of committed entries so briefly-lagging followers catch
+      up from the log instead of tripping snapshot installation;
+    * service snapshot downloads instantly: last tick's ``snap_req`` comes
+      back as this tick's ``snap_done`` (the payload-less analog of the
+      reference's out-of-band snapshot channel, EventNode.java:122-267).
+    """
+    G = cfg.n_groups
+    slack = cfg.log_slots // 4
+
+    def one(st, sub, info):
+        hi = HostInbox.empty(cfg)
+        ct = (jnp.maximum(st.commit - slack, 0) if compact
+              else jnp.zeros((G,), jnp.int32))
+        return hi.replace(
+            submit_n=sub,
+            compact_to=ct,
+            snap_done=info.snap_req,
+            snap_idx=info.snap_req_idx,
+            snap_term=info.snap_req_term,
+        )
+    return jax.vmap(one)(states, submit_n, prev_info)
+
+
+class DeviceCluster:
+    """Host-side driver for an all-on-device N-node Multi-Raft cluster.
+
+    The in-process many-node harness — the generalization of the reference's
+    loopback test trick (transport/EventClusterTest.java:81-83) — used by the
+    test suite, the chaos/parity oracle and the benchmark.
+    """
+
+    def __init__(self, cfg: EngineConfig, seed: int = 0,
+                 n_active: int | None = None):
+        self.cfg = cfg
+        N = cfg.n_peers
+        states = [init_state(cfg, i, seed=seed, n_active=n_active)
+                  for i in range(N)]
+        self.states: RaftState = jax.tree.map(
+            lambda *xs: jnp.stack(xs), *states)
+        self.inflight: Messages = jax.tree.map(
+            lambda a: jnp.broadcast_to(a, (N,) + a.shape).copy(),
+            Messages.empty(cfg))
+        self.conn = jnp.ones((N, N), jnp.bool_)
+        self.last_info: StepInfo = jax.tree.map(
+            lambda a: jnp.broadcast_to(a, (N,) + a.shape).copy(),
+            StepInfo.empty(cfg))
+
+    # -- fault injection ----------------------------------------------------
+    def set_partition(self, groups_of_nodes) -> None:
+        """Partition the cluster: nodes can only reach their own side."""
+        N = self.cfg.n_peers
+        conn = np.zeros((N, N), bool)
+        for side in groups_of_nodes:
+            for a in side:
+                for b in side:
+                    conn[a, b] = True
+        self.conn = jnp.asarray(conn)
+
+    def heal(self) -> None:
+        self.conn = jnp.ones((self.cfg.n_peers,) * 2, jnp.bool_)
+
+    def isolate(self, node: int) -> None:
+        N = self.cfg.n_peers
+        self.set_partition([[n for n in range(N) if n != node], [node]])
+
+    # -- stepping -----------------------------------------------------------
+    def tick(self, submit_n=None, host: Optional[HostInbox] = None) -> StepInfo:
+        N, G = self.cfg.n_peers, self.cfg.n_groups
+        if host is None:
+            if submit_n is None:
+                sub = jnp.zeros((N, G), jnp.int32)
+            else:
+                sub = jnp.asarray(submit_n, jnp.int32)
+                if sub.ndim == 0:
+                    sub = jnp.broadcast_to(sub, (N, G))
+            host = auto_host_inbox(self.cfg, self.states, sub, True,
+                                   self.last_info)
+        self.states, self.inflight, info = cluster_step(
+            self.cfg, self.states, self.inflight, host, self.conn)
+        self.last_info = info
+        return info
+
+    def run(self, n_ticks: int, submit_n=None) -> None:
+        for _ in range(n_ticks):
+            self.tick(submit_n)
+
+    # -- inspection ---------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Pull the whole cluster state to host numpy for assertions."""
+        return {
+            "term": np.asarray(self.states.term),
+            "role": np.asarray(self.states.role),
+            "voted_for": np.asarray(self.states.voted_for),
+            "leader_id": np.asarray(self.states.leader_id),
+            "commit": np.asarray(self.states.commit),
+            "last": np.asarray(self.states.log.last),
+            "base": np.asarray(self.states.log.base),
+            "log_term": np.asarray(self.states.log.term),
+            "now": np.asarray(self.states.now),
+        }
+
+    def leaders(self, group: int = 0) -> list[int]:
+        role = np.asarray(self.states.role[:, group])
+        return [int(n) for n in np.nonzero(role == LEADER)[0]]
+
+    def log_terms(self, node: int, group: int, lo: int, hi: int) -> list[int]:
+        """Entry terms for indices [lo, hi] on one node (host-side read)."""
+        L = self.cfg.log_slots
+        ring = np.asarray(self.states.log.term[node, group])
+        base = int(self.states.log.base[node, group])
+        last = int(self.states.log.last[node, group])
+        out = []
+        for i in range(lo, hi + 1):
+            if i <= base or i > last:
+                out.append(None)
+            else:
+                out.append(int(ring[i % L]))
+        return out

@@ -1,0 +1,515 @@
+"""The vectorized Multi-Raft step kernel.
+
+``node_step`` advances EVERY Raft group on a node by one logical tick in a
+single fused XLA program: message-driven term sync, vote grant/tally,
+AppendEntries consistency + conflict handling, leader bookkeeping, timer
+expiry, client submission, replication fan-out and quorum commit — all as
+masked vector operations over group-major arrays.
+
+This replaces the reference's entire per-group concurrency layer (event loops,
+CAS role switches, timer fencing: support/EventLoop.java, context/
+RaftRoutine.java:86-216) with data parallelism.  Semantics are kept faithful
+to the reference's Raft implementation; each phase cites the Java code whose
+behavior it vectorizes.
+
+Phase order within a tick (messages produced in tick t are delivered in t+1):
+  1. term sync           — step down on any higher inbound term
+  2. vote requests       — grant PreVote/RequestVote, produce replies
+  3. vote responses      — tally; PRE_CANDIDATE→CANDIDATE→LEADER transitions
+  4. AppendEntries reqs  — consistency check, conflict truncate, append, commit
+  5. InstallSnapshot     — offer handling + completion events from host
+  6. AppendEntries resps — leader match/next bookkeeping
+  7. timers              — election timeout → PreVote round / new election
+  8. submissions         — leader accepts client commands into the log
+  9. replication         — leader builds AppendEntries / snapshot offers
+ 10. commit advance      — quorum median over matchIndex, own-term rule
+"""
+
+from __future__ import annotations
+
+import functools
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .types import (
+    CANDIDATE, FOLLOWER, LEADER, NIL, PRE_CANDIDATE, I32,
+    EngineConfig, HostInbox, LogState, Messages, RaftState, StepInfo,
+)
+
+Array = jax.Array
+
+
+# ---------------------------------------------------------------------------
+# Log-ring primitives.  The log is a per-group ring of entry terms: index i
+# lives at slot i % L.  Entries (base, last] are live; `base` carries
+# base_term (the snapshot milestone, reference StableLock.java:82-91).
+# ---------------------------------------------------------------------------
+
+def ring_term_at(log: LogState, idx: Array) -> Array:
+    """Term of entry `idx` per group ([G] -> [G]).
+
+    idx == base  -> base_term (milestone);  idx < base -> compacted (returns
+    base_term; callers treat anything <= base as matching — compacted entries
+    are committed, hence matched, the reference's purgeEntries rationale,
+    Follower.java:209-221).  idx > last -> -1 (absent).
+    """
+    L = log.term.shape[1]
+    slot = jnp.remainder(idx, L)
+    t = jnp.take_along_axis(log.term, slot[:, None], axis=1)[:, 0]
+    return jnp.where(idx <= log.base, log.base_term,
+                     jnp.where(idx <= log.last, t, jnp.asarray(-1, I32)))
+
+
+def ring_terms_batch(log: LogState, idx: Array) -> Array:
+    """Terms for a [G, B] index matrix (absent -> -1)."""
+    L = log.term.shape[1]
+    slot = jnp.remainder(idx, L)
+    t = jnp.take_along_axis(log.term, slot, axis=1)
+    return jnp.where(idx <= log.base[:, None], log.base_term[:, None],
+                     jnp.where(idx <= log.last[:, None], t, jnp.asarray(-1, I32)))
+
+
+def ring_write_batch(log_term: Array, idx: Array, vals: Array, mask: Array) -> Array:
+    """Masked scatter of entry terms at [G, B] indices into the [G, L] ring."""
+    G, L = log_term.shape
+    rows = jnp.broadcast_to(jnp.arange(G, dtype=I32)[:, None], idx.shape)
+    slot = jnp.where(mask, jnp.remainder(idx, L), L)  # L = out of range -> dropped
+    return log_term.at[rows, slot].set(vals, mode="drop")
+
+
+# ---------------------------------------------------------------------------
+# The step
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnums=0, donate_argnums=1)
+def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
+              host: HostInbox) -> Tuple[RaftState, Messages, StepInfo]:
+    G, P, B, L, S = (cfg.n_groups, cfg.n_peers, cfg.batch, cfg.log_slots,
+                     cfg.max_submit)
+    s = state
+    now = s.now + 1
+    rng, k_to = jax.random.split(s.rng)
+    # One randomized election window per group per tick, consumed by whichever
+    # lanes reset their timer (reference RaftConfig.electionTimeout re-draws on
+    # every read, support/RaftConfig.java:187-190).
+    rand_to = jax.random.randint(k_to, (G,), cfg.election_ticks,
+                                 2 * cfg.election_ticks, dtype=I32)
+
+    me = s.node_id
+    peer_axis = jnp.arange(P, dtype=I32)
+    self_hot = peer_axis[None, :] == me          # [1, P] one-hot row for self
+
+    active = s.active
+    term, role, voted = s.term, s.role, s.voted_for
+    leader_id, commit = s.leader_id, s.commit
+    log = s.log
+    next_idx, match_idx = s.next_idx, s.match_idx
+    awaiting, sent_at, need_snap = s.awaiting, s.sent_at, s.need_snap
+    votes, prevotes = s.votes, s.prevotes
+    elect_dl, hb_due = s.elect_deadline, s.hb_due
+
+    old_term, old_voted, old_last = term, voted, log.last
+
+    # ---- 1. term sync: adopt the highest real term seen this tick ---------
+    # (the universal Raft rule; reference applies it per-RPC via
+    # switchTo(Follower, term): Follower.java:45-47, Candidate.java:28-41,
+    # Leader step-down Leader.java:224-227.  PreVote requests are excluded:
+    # their term is speculative and must not bump ours.)
+    neg = jnp.asarray(-1, I32)
+    def masked(valid, t):
+        return jnp.where(valid, t, neg)
+    mt = functools.reduce(jnp.maximum, [
+        masked(inbox.ae_valid, inbox.ae_term),
+        masked(inbox.aer_valid, inbox.aer_term),
+        masked(inbox.rv_valid & ~inbox.rv_prevote, inbox.rv_term),
+        masked(inbox.rvr_valid, inbox.rvr_term),
+        masked(inbox.is_valid, inbox.is_term),
+        masked(inbox.isr_valid, inbox.isr_term),
+    ]).max(axis=0)                                           # [G]
+    stepdown = active & (mt > term)
+    term = jnp.where(stepdown, mt, term)
+    role = jnp.where(stepdown, FOLLOWER, role)
+    voted = jnp.where(stepdown, NIL, voted)
+    leader_id = jnp.where(stepdown, NIL, leader_id)
+    elect_dl = jnp.where(stepdown, now + rand_to, elect_dl)
+
+    last_term_v = ring_term_at(log, log.last)
+
+    # ---- 2. vote requests --------------------------------------------------
+    # Sequential fold over peers so at most one RequestVote is granted per
+    # term even when several arrive in the same tick (votedFor updates are
+    # visible to the next peer's evaluation).
+    rvr_valid_o, rvr_term_o, rvr_granted_o, rvr_prevote_o, rvr_echo_o = \
+        [], [], [], [], []
+    for p in range(P):
+        pid = jnp.asarray(p, I32)
+        v = inbox.rv_valid[p] & active & (pid != me)
+        pv = inbox.rv_prevote[p]
+        rterm = inbox.rv_term[p]
+        # Log up-to-date check (reference Follower.logUpToDate:193-207).
+        utd = ((inbox.rv_last_term[p] > last_term_v) |
+               ((inbox.rv_last_term[p] == last_term_v) &
+                (inbox.rv_last_idx[p] >= log.last)))
+        # RequestVote grant (reference Follower.requestVote:108-127): same
+        # term (sync already adopted any higher term), unburned ballot,
+        # up-to-date log.
+        grant_rv = (v & ~pv & (rterm == term) &
+                    ((voted == NIL) | (voted == pid)) & utd)
+        voted = jnp.where(grant_rv, pid, voted)
+        elect_dl = jnp.where(grant_rv, now + rand_to, elect_dl)
+        # PreVote grant (reference Follower.preVote:91-105): only if we
+        # ourselves have detected leader silence (lease), log up-to-date and
+        # the speculative term is ahead.  No durable state changes.
+        lease_open = (now >= elect_dl) | (leader_id == NIL)
+        grant_pv = v & pv & (rterm > term) & utd & lease_open
+        rvr_valid_o.append(v)
+        rvr_term_o.append(term)
+        rvr_granted_o.append(jnp.where(pv, grant_pv, grant_rv))
+        rvr_prevote_o.append(pv)
+        rvr_echo_o.append(rterm)
+
+    # ---- 3. vote responses + tallies --------------------------------------
+    for p in range(P):
+        r = inbox.rvr_valid[p] & active
+        # PreVote tally: accept grants only for the round we are still in —
+        # the echoed requested term must equal term+1 (vectorized analog of
+        # AsyncHead cancellation of stale rounds, Async.java:70-172).
+        g_pv = (r & inbox.rvr_prevote[p] & inbox.rvr_granted[p] &
+                (role == PRE_CANDIDATE) & (inbox.rvr_echo[p] == term + 1))
+        prevotes = prevotes.at[:, p].set(prevotes[:, p] | g_pv)
+        # Real vote tally (reference Candidate.startElection:112-134): a
+        # grant implies the responder adopted our term, so term equality is
+        # the staleness fence.
+        g_rv = (r & ~inbox.rvr_prevote[p] & inbox.rvr_granted[p] &
+                (role == CANDIDATE) & (inbox.rvr_term[p] == term))
+        votes = votes.at[:, p].set(votes[:, p] | g_rv)
+
+    maj = jnp.asarray(cfg.majority, I32)
+    pv_win = (role == PRE_CANDIDATE) & (prevotes.sum(axis=1) >= maj)
+    # PreVote majority -> real candidacy at term+1 (reference
+    # Follower.prepareElection:264-267 -> trySwitchTo(Candidate, term+1)).
+    become_cand_pv = pv_win
+    term = jnp.where(become_cand_pv, term + 1, term)
+    role = jnp.where(become_cand_pv, CANDIDATE, role)
+    voted = jnp.where(become_cand_pv, me, voted)
+    leader_id = jnp.where(become_cand_pv, NIL, leader_id)
+    votes = jnp.where(become_cand_pv[:, None], self_hot, votes)
+    elect_dl = jnp.where(become_cand_pv, now + rand_to, elect_dl)
+
+    vote_win = (role == CANDIDATE) & (votes.sum(axis=1) >= maj)
+    # Candidate majority -> Leader (reference Candidate.java:128-131 ->
+    # Leader ctor + prepareReplication, Leader.java:25-50): reset the
+    # replication matrix and heartbeat immediately.
+    role = jnp.where(vote_win, LEADER, role)
+    leader_id = jnp.where(vote_win, me, leader_id)
+    next_idx = jnp.where(vote_win[:, None], log.last[:, None] + 1, next_idx)
+    match_idx = jnp.where(vote_win[:, None], 0, match_idx)
+    awaiting = jnp.where(vote_win[:, None], False, awaiting)
+    need_snap = jnp.where(vote_win[:, None], False, need_snap)
+    hb_due = jnp.where(vote_win, now, hb_due)
+
+    # ---- 4. AppendEntries requests ----------------------------------------
+    # (reference Follower.appendEntries:35-88 — consistency check, conflict
+    # truncation, append, passive commit.)
+    aer_valid_o, aer_term_o, aer_success_o, aer_match_o = [], [], [], []
+    app_from = jnp.zeros((G,), I32)
+    app_to = jnp.zeros((G,), I32)
+    col = jnp.arange(B, dtype=I32)[None, :]
+    for p in range(P):
+        pid = jnp.asarray(p, I32)
+        v = inbox.ae_valid[p] & active & (pid != me)
+        t_ok = v & (inbox.ae_term[p] == term)
+        # A valid leader at our term: candidates/pre-candidates step down
+        # (reference Candidate.appendEntries:28-41); election timer resets
+        # (Follower.java:43).
+        role = jnp.where(t_ok & (role != LEADER), FOLLOWER, role)
+        leader_id = jnp.where(t_ok, pid, leader_id)
+        elect_dl = jnp.where(t_ok, now + rand_to, elect_dl)
+
+        prev_i = inbox.ae_prev_idx[p]
+        n_e = inbox.ae_n[p]
+        # Consistency: prev entry matches, or prev is at/under our compaction
+        # floor (compacted == committed == matched; reference
+        # Follower.logContains:177-191 + purgeEntries:209-221).
+        prev_match = ((prev_i <= log.base) |
+                      ((prev_i <= log.last) &
+                       (ring_term_at(log, prev_i) == inbox.ae_prev_term[p])))
+        acc = t_ok & prev_match
+
+        idxs = prev_i[:, None] + 1 + col                       # [G, B]
+        ents = inbox.ae_ents[p]
+        in_n = col < n_e[:, None]
+        exists = (idxs <= log.last[:, None]) & (idxs > log.base[:, None])
+        cur = ring_terms_batch(log, idxs)
+        conflict = (acc[:, None] & in_n & exists & (cur != ents)).any(axis=1)
+        wmask = acc[:, None] & in_n & (idxs > log.base[:, None])
+        new_term_ring = ring_write_batch(log.term, idxs, ents, wmask)
+        tail = prev_i + n_e
+        # Conflict => truncate-then-append == overwrite + last = prev+n;
+        # no conflict => never shrink (stale/duplicate RPC; reference
+        # RocksLog.conflict:199-216 + truncate:219-225 + append:169-196).
+        new_last = jnp.where(acc,
+                             jnp.where(conflict, tail,
+                                       jnp.maximum(log.last, tail)),
+                             log.last)
+        wrote = acc & (n_e > 0) & ((new_last != log.last) | conflict)
+        app_from = jnp.where(wrote & (app_from == 0), prev_i + 1,
+                             jnp.where(wrote, jnp.minimum(app_from, prev_i + 1),
+                                       app_from))
+        app_to = jnp.where(wrote, jnp.maximum(app_to, new_last), app_to)
+        log = log.replace(term=new_term_ring, last=new_last)
+        # Passive commit (reference Follower.java:76-82): min(leaderCommit,
+        # last new entry), monotone.
+        commit = jnp.where(acc,
+                           jnp.maximum(commit,
+                                       jnp.minimum(inbox.ae_commit[p], new_last)),
+                           commit)
+        # Reply: success carries the new match point; failure carries a
+        # nextIndex hint = min(our last, prev-1) — an accelerated version of
+        # the reference's log-scaled backoff (Leadership.updateIndex:75-114).
+        aer_valid_o.append(v)
+        aer_term_o.append(term)
+        aer_success_o.append(acc)
+        aer_match_o.append(jnp.where(acc, tail,
+                                     jnp.minimum(log.last, prev_i - 1)))
+
+    # ---- 5. InstallSnapshot ------------------------------------------------
+    # Device plane: an offer merely tells the follower's host to start the
+    # bulk download (side channel, reference EventNode.SnapChannel:122-267).
+    # The host reports completion via HostInbox.snap_done, at which point the
+    # log floor jumps to the milestone (reference
+    # RaftRoutine.accomplishInstallation:451-475 — log.flush(milestone)).
+    snap_req = jnp.zeros((G,), jnp.bool_)
+    snap_from = jnp.zeros((G,), I32)
+    snap_idx_o = jnp.zeros((G,), I32)
+    snap_term_o = jnp.zeros((G,), I32)
+    isr_valid_o, isr_term_o, isr_success_o = [], [], []
+    for p in range(P):
+        pid = jnp.asarray(p, I32)
+        v = inbox.is_valid[p] & active & (pid != me)
+        t_ok = v & (inbox.is_term[p] == term)
+        role = jnp.where(t_ok & (role != LEADER), FOLLOWER, role)
+        leader_id = jnp.where(t_ok, pid, leader_id)
+        elect_dl = jnp.where(t_ok, now + rand_to, elect_dl)
+        # Success only once the milestone is covered: either our snapshot
+        # floor already includes it, or we hold a matching entry at that
+        # index.  While the bulk download is still in flight we answer
+        # failure so the leader keeps the installation pending (reference
+        # PendingSnapshot tracking, SnapshotArchive.java:197-211).
+        covered = ((inbox.is_idx[p] <= log.base) |
+                   ((inbox.is_idx[p] <= log.last) &
+                    (ring_term_at(log, inbox.is_idx[p]) ==
+                     inbox.is_last_term[p])))
+        useful = t_ok & ~covered
+        snap_req = snap_req | useful
+        snap_from = jnp.where(useful, pid, snap_from)
+        snap_idx_o = jnp.where(useful, inbox.is_idx[p], snap_idx_o)
+        snap_term_o = jnp.where(useful, inbox.is_last_term[p], snap_term_o)
+        isr_valid_o.append(v)
+        isr_term_o.append(term)
+        isr_success_o.append(t_ok & covered)
+
+    # Host finished installing a snapshot: adopt the milestone as the new
+    # log floor (truncating everything) and move commit/applied up.
+    sd = host.snap_done & active & (host.snap_idx > log.base)
+    log = log.replace(
+        base=jnp.where(sd, host.snap_idx, log.base),
+        base_term=jnp.where(sd, host.snap_term, log.base_term),
+        last=jnp.where(sd, jnp.maximum(log.last, host.snap_idx), log.last),
+    )
+    # Entries between old base and the milestone are gone; if our last was
+    # behind the milestone the ring holds nothing live beyond it.
+    log = log.replace(last=jnp.where(sd & (log.last < log.base), log.base, log.last))
+    commit = jnp.where(sd, jnp.maximum(commit, host.snap_idx), commit)
+
+    # Compaction grant from host (snapshot taken at compact_to): raise floor,
+    # but never past commit (reference compactLog gates on the snapshot
+    # milestone, RaftRoutine.java:365-400).  The milestone term is read from
+    # the ring *before* the floor moves.
+    ct = jnp.minimum(host.compact_to, commit)
+    do_c = active & (ct > log.base)
+    ct_term = ring_term_at(log, ct)
+    log = log.replace(base=jnp.where(do_c, ct, log.base),
+                      base_term=jnp.where(do_c, ct_term, log.base_term))
+
+    # ---- 6. AppendEntries responses (leader bookkeeping) -------------------
+    # (reference Leader reply handling, Leader.java:224-243 +
+    # Leadership.State.updateIndex:75-114.)
+    for p in range(P):
+        r = inbox.aer_valid[p] & active & (role == LEADER) & \
+            (inbox.aer_term[p] == term)
+        suc = r & inbox.aer_success[p]
+        fail = r & ~inbox.aer_success[p]
+        m_new = jnp.maximum(match_idx[:, p], inbox.aer_match[p])
+        match_idx = match_idx.at[:, p].set(jnp.where(suc, m_new, match_idx[:, p]))
+        nx = jnp.where(suc, jnp.maximum(next_idx[:, p], m_new + 1),
+                       jnp.where(fail,
+                                 jnp.clip(inbox.aer_match[p] + 1, 1, next_idx[:, p]),
+                                 next_idx[:, p]))
+        # Follower fell below our compaction floor -> needs a snapshot
+        # (reference Leadership.java:111-113 pendingInstallation trigger).
+        ns = fail & (nx <= log.base)
+        need_snap = need_snap.at[:, p].set(jnp.where(r, ns, need_snap[:, p]))
+        next_idx = next_idx.at[:, p].set(jnp.maximum(nx, log.base + 1))
+        awaiting = awaiting.at[:, p].set(jnp.where(r, False, awaiting[:, p]))
+
+    # Snapshot response: success means the follower now covers our offered
+    # milestone — resume log replication from just past our floor (reference
+    # accomplishInstallation -> normal AppendEntries flow,
+    # RaftRoutine.java:451-475).  Failure = still downloading; keep pending.
+    for p in range(P):
+        r = inbox.isr_valid[p] & active & (role == LEADER) & \
+            (inbox.isr_term[p] == term)
+        ok = r & inbox.isr_success[p]
+        need_snap = need_snap.at[:, p].set(jnp.where(ok, False, need_snap[:, p]))
+        next_idx = next_idx.at[:, p].set(
+            jnp.where(ok, jnp.maximum(next_idx[:, p], log.base + 1),
+                      next_idx[:, p]))
+        match_idx = match_idx.at[:, p].set(
+            jnp.where(ok, jnp.maximum(match_idx[:, p], log.base),
+                      match_idx[:, p]))
+        awaiting = awaiting.at[:, p].set(jnp.where(r, False, awaiting[:, p]))
+
+    # ---- 7. timers ---------------------------------------------------------
+    # (reference RaftRoutine.electionTimeout:65-77 -> Follower.onTimeout:
+    # 156-168: PreVote round if enabled, else direct candidacy; candidate
+    # timeout restarts the election at term+1, Candidate.onTimeout:82-88.)
+    expired = active & (now >= elect_dl) & (role != LEADER)
+    if cfg.pre_vote:
+        start_pre = expired & ((role == FOLLOWER) | (role == PRE_CANDIDATE))
+        timer_cand = expired & (role == CANDIDATE)
+    else:
+        start_pre = jnp.zeros((G,), jnp.bool_)
+        timer_cand = expired
+    term = jnp.where(timer_cand, term + 1, term)
+    voted = jnp.where(timer_cand, me, voted)
+    role = jnp.where(timer_cand, CANDIDATE, jnp.where(start_pre, PRE_CANDIDATE, role))
+    leader_id = jnp.where(timer_cand | start_pre, NIL, leader_id)
+    votes = jnp.where(timer_cand[:, None], self_hot, votes)
+    prevotes = jnp.where(start_pre[:, None], self_hot, prevotes)
+    elect_dl = jnp.where(timer_cand | start_pre, now + rand_to, elect_dl)
+
+    became_cand = become_cand_pv | timer_cand
+    last_term_v = ring_term_at(log, log.last)
+
+    # ---- 8. client submissions --------------------------------------------
+    # (reference RaftStub.submit -> Leader.acceptCommand -> log.newEntry,
+    # RaftStub.java:65-74, Leader.java:128-140, RocksLog.java:82-89.)
+    # Capacity gate: the ring must keep (last - base) <= L.
+    free = L - (log.last - log.base)
+    n_acc = jnp.where(active & (role == LEADER),
+                      jnp.clip(host.submit_n, 0, jnp.minimum(free, S)), 0)
+    sub_start = log.last + 1
+    sidx = log.last[:, None] + 1 + jnp.arange(S, dtype=I32)[None, :]
+    smask = jnp.arange(S, dtype=I32)[None, :] < n_acc[:, None]
+    new_ring = ring_write_batch(log.term, sidx,
+                                jnp.broadcast_to(term[:, None], (G, S)), smask)
+    log = log.replace(term=new_ring, last=log.last + n_acc)
+    app_from = jnp.where((n_acc > 0) & (app_from == 0), sub_start, app_from)
+    app_to = jnp.where(n_acc > 0, log.last, app_to)
+
+    # ---- 9. replication fan-out -------------------------------------------
+    # (reference Leader.replicateLog:142-245 — the hot loop, now a dense
+    # (group x peer) batch build straight from the HBM ring.)
+    heartbeat = (role == LEADER) & (now >= hb_due)
+    ae_valid_o, ae_term_o, ae_prev_o, ae_pterm_o, ae_commit_o, ae_n_o, \
+        ae_ents_o = [], [], [], [], [], [], []
+    is_valid_o2, is_term_o2, is_idx_o2, is_lterm_o2 = [], [], [], []
+    for p in range(P):
+        pid = jnp.asarray(p, I32)
+        is_peer = (pid != me)
+        nx = next_idx[:, p]
+        n_avail = jnp.clip(log.last - nx + 1, 0, B)
+        has_data = (log.last >= nx) & ~need_snap[:, p]
+        resend_ok = (~awaiting[:, p]) | (now - sent_at[:, p] >=
+                                         cfg.rpc_timeout_ticks)
+        send_ae = (active & (role == LEADER) & is_peer & ~need_snap[:, p] &
+                   resend_ok & (has_data | heartbeat))
+        n_send = jnp.where(has_data, n_avail, 0)
+        prev = nx - 1
+        ents = ring_terms_batch(log, nx[:, None] + col)
+        ae_valid_o.append(send_ae)
+        ae_term_o.append(term)
+        ae_prev_o.append(prev)
+        ae_pterm_o.append(ring_term_at(log, prev))
+        ae_commit_o.append(commit)
+        ae_n_o.append(n_send)
+        ae_ents_o.append(ents)
+        # Snapshot offer for laggards (reference Leader.java:168-190).
+        send_is = (active & (role == LEADER) & is_peer & need_snap[:, p] &
+                   resend_ok)
+        is_valid_o2.append(send_is)
+        is_term_o2.append(term)
+        is_idx_o2.append(log.base)
+        is_lterm_o2.append(log.base_term)
+        sent = send_ae | send_is
+        awaiting = awaiting.at[:, p].set(jnp.where(sent & (has_data | send_is),
+                                                   True, awaiting[:, p]))
+        sent_at = sent_at.at[:, p].set(jnp.where(sent, now, sent_at[:, p]))
+    hb_due = jnp.where(heartbeat, now + cfg.heartbeat_ticks, hb_due)
+
+    # Election broadcasts (PreVote at speculative term+1 carrying our log
+    # position, reference Follower.prepareElection:223-279; RequestVote at the
+    # new term, Candidate.startElection:90-143).
+    rv_valid_o, rv_term_o, rv_lidx_o, rv_lterm_o, rv_pv_o = [], [], [], [], []
+    for p in range(P):
+        pid = jnp.asarray(p, I32)
+        is_peer = (pid != me)
+        v = (became_cand | start_pre) & is_peer & active
+        rv_valid_o.append(v)
+        rv_term_o.append(jnp.where(start_pre, term + 1, term))
+        rv_lidx_o.append(log.last)
+        rv_lterm_o.append(last_term_v)
+        rv_pv_o.append(start_pre)
+
+    # ---- 10. commit advance ------------------------------------------------
+    # Quorum median over the match matrix with self = last (reference
+    # Leadership.majorIndices:116-130), gated by the commit-only-own-term
+    # rule (reference Leader.tryCommit:256-261, Raft §5.4.2).
+    match_full = jnp.where(self_hot, log.last[:, None], match_idx)
+    sorted_m = jnp.sort(match_full, axis=1)
+    quorum_idx = sorted_m[:, P - cfg.majority]
+    can_commit = (active & (role == LEADER) & (quorum_idx > commit) &
+                  (ring_term_at(log, quorum_idx) == term))
+    commit = jnp.where(can_commit, quorum_idx, commit)
+    match_idx = jnp.where(self_hot, log.last[:, None], match_idx)
+
+    dirty = (term != old_term) | (voted != old_voted) | (log.last != old_last) \
+        | (app_to > 0)
+
+    new_state = RaftState(
+        node_id=s.node_id, now=now, rng=rng, active=active,
+        term=term, role=role, voted_for=voted, leader_id=leader_id,
+        commit=commit, applied=s.applied, log=log,
+        next_idx=next_idx, match_idx=match_idx, awaiting=awaiting,
+        sent_at=sent_at, need_snap=need_snap, votes=votes, prevotes=prevotes,
+        elect_deadline=elect_dl, hb_due=hb_due,
+    )
+    outbox = Messages(
+        ae_valid=jnp.stack(ae_valid_o), ae_term=jnp.stack(ae_term_o),
+        ae_prev_idx=jnp.stack(ae_prev_o), ae_prev_term=jnp.stack(ae_pterm_o),
+        ae_commit=jnp.stack(ae_commit_o), ae_n=jnp.stack(ae_n_o),
+        ae_ents=jnp.stack(ae_ents_o),
+        aer_valid=jnp.stack(aer_valid_o), aer_term=jnp.stack(aer_term_o),
+        aer_success=jnp.stack(aer_success_o), aer_match=jnp.stack(aer_match_o),
+        rv_valid=jnp.stack(rv_valid_o), rv_term=jnp.stack(rv_term_o),
+        rv_last_idx=jnp.stack(rv_lidx_o), rv_last_term=jnp.stack(rv_lterm_o),
+        rv_prevote=jnp.stack(rv_pv_o),
+        rvr_valid=jnp.stack(rvr_valid_o), rvr_term=jnp.stack(rvr_term_o),
+        rvr_granted=jnp.stack(rvr_granted_o),
+        rvr_prevote=jnp.stack(rvr_prevote_o), rvr_echo=jnp.stack(rvr_echo_o),
+        is_valid=jnp.stack(is_valid_o2), is_term=jnp.stack(is_term_o2),
+        is_idx=jnp.stack(is_idx_o2), is_last_term=jnp.stack(is_lterm_o2),
+        isr_valid=jnp.stack(isr_valid_o), isr_term=jnp.stack(isr_term_o),
+        isr_success=jnp.stack(isr_success_o),
+    )
+    info = StepInfo(
+        submit_start=sub_start, submit_acc=n_acc, dirty=dirty,
+        appended_from=app_from, appended_to=app_to, commit=commit,
+        leader=leader_id, snap_req=snap_req, snap_req_from=snap_from,
+        snap_req_idx=snap_idx_o, snap_req_term=snap_term_o,
+    )
+    return new_state, outbox, info

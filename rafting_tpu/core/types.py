@@ -1,0 +1,306 @@
+"""Core value types for the vectorized Multi-Raft engine.
+
+Design inversion vs the reference (curioloop/rafting): instead of one
+``RaftContext`` object + event loop per group (reference:
+context/RaftContext.java:34, support/EventLoop.java:14), the consensus state of
+ALL groups on a node lives in group-major JAX arrays, and a single jitted step
+function advances every group at once.  Roles, terms, votes and timers are
+vector lanes; "switch role" (reference: context/RaftRoutine.java:140-216) is a
+masked update, not an object swap.
+
+Index conventions
+-----------------
+* Log indices start at 1; index 0 is the empty sentinel.  ``base`` is the
+  compaction floor (the reference's "epoch", command/RaftLog.java:25-66):
+  entries in ``(base, last]`` are live, ``base`` itself carries ``base_term``
+  (the snapshot milestone term).
+* Peer slot p in any ``[G, P]`` / ``[P, G]`` array refers to cluster node id p.
+  A node's own slot is inert (never sent to, masked everywhere).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+# Role lattice (reference: context/member/Membership.java:74-108 defines the
+# total order used for transitions; here roles are just lane values and the
+# lattice is enforced by the masked-update order inside the step kernel).
+FOLLOWER = 0
+PRE_CANDIDATE = 1
+CANDIDATE = 2
+LEADER = 3
+
+NIL = -1  # "no vote" / "no leader" sentinel (reference: votedFor == null)
+
+I32 = jnp.int32
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Static (hashable) engine configuration — the jit-time shape contract.
+
+    Mirrors the semantics of the reference's RaftConfig
+    (support/RaftConfig.java:27, 187-198): all timing derives from an abstract
+    tick; election timeouts are randomized in [T, 2T).
+    """
+
+    n_groups: int                 # G — groups resident on this node
+    n_peers: int                  # P — cluster size (incl. self); peer id == node id
+    log_slots: int = 64           # L — per-group log ring capacity (power of two)
+    batch: int = 8                # B — max entries per AppendEntries
+                                  #     (reference REPLICATE_LIMIT=50, Leadership.java:10)
+    max_submit: int = 8           # S — max client commands accepted per group per tick
+    election_ticks: int = 10      # T — election timeout base, randomized [T, 2T)
+                                  #     (reference RaftConfig.java:187-190)
+    heartbeat_ticks: int = 3      # heartbeat interval (reference RaftConfig.java:192-194)
+    rpc_timeout_ticks: int = 8    # re-send an un-acked AppendEntries after this long
+                                  #     (reference: per-RPC timeout, Async.java:177-256)
+    pre_vote: bool = True         # PreVote phase enabled (reference RaftConfig.java:97-100)
+
+    def __post_init__(self):
+        assert self.n_peers >= 1
+        assert self.log_slots & (self.log_slots - 1) == 0, "log_slots must be a power of 2"
+        assert self.batch <= self.log_slots
+        assert self.heartbeat_ticks < self.election_ticks
+
+    @property
+    def majority(self) -> int:
+        return self.n_peers // 2 + 1
+
+
+@struct.dataclass
+class LogState:
+    """Device-resident log *metadata* for all groups: entry terms in a ring.
+
+    Payload bytes live on the host (keyed by (group, index)); the device only
+    needs terms to run consistency checks, conflict scans and the
+    commit-only-own-term rule (reference: RocksLog stores term-prefixed values,
+    command/storage/RocksLog.java:82-89; conflict scan at 199-216).
+    """
+
+    term: jax.Array       # [G, L] int32 — term of entry at slot (index % L)
+    base: jax.Array       # [G] int32 — compaction floor ("epoch"); entries (base, last] live
+    base_term: jax.Array  # [G] int32 — term of the entry at `base` (snapshot milestone term)
+    last: jax.Array       # [G] int32 — last appended index (0 = empty)
+
+
+@struct.dataclass
+class RaftState:
+    """Group-major consensus state for one node — the whole Multi-Raft node.
+
+    Replaces the reference's per-group object graph: RaftContext fields
+    (context/RaftContext.java:34-89), role objects (context/member/*.java),
+    Leadership.State per-follower bookkeeping (context/member/Leadership.java)
+    and TimerTicket deadlines (context/member/TimerTicket.java).
+    """
+
+    node_id: jax.Array        # scalar int32 — this node's id (== its peer slot)
+    now: jax.Array            # scalar int32 — logical tick clock
+    rng: jax.Array            # PRNG key for randomized election timeouts
+
+    active: jax.Array         # [G] bool — group exists & is open (admin lifecycle)
+    term: jax.Array           # [G] int32 — currentTerm
+    role: jax.Array           # [G] int32 — FOLLOWER / PRE_CANDIDATE / CANDIDATE / LEADER
+    voted_for: jax.Array      # [G] int32 — ballot, NIL if none
+    leader_id: jax.Array      # [G] int32 — last known leader (redirect hint), NIL unknown
+    commit: jax.Array         # [G] int32 — commitIndex
+    applied: jax.Array        # [G] int32 — host-acknowledged apply frontier
+
+    log: LogState
+
+    # Leader-side replication bookkeeping (reference Leadership.State,
+    # context/member/Leadership.java:30-114).
+    next_idx: jax.Array       # [G, P] int32
+    match_idx: jax.Array      # [G, P] int32
+    awaiting: jax.Array       # [G, P] bool — an AppendEntries is in flight
+    sent_at: jax.Array        # [G, P] int32 — tick of last send (for re-send timeout)
+    need_snap: jax.Array      # [G, P] bool — follower fell behind compaction floor
+                              #   (reference pendingInstallation, Leadership.java:111-113)
+
+    # Election tallies (reference: AtomicInteger vote counts,
+    # Candidate.java:112; Follower.prepareElection:241-275).
+    votes: jax.Array          # [G, P] bool — RequestVote grants received this term
+    prevotes: jax.Array       # [G, P] bool — PreVote grants received this round
+
+    elect_deadline: jax.Array # [G] int32 — election timer deadline (tick)
+    hb_due: jax.Array         # [G] int32 — next heartbeat tick (leader)
+
+
+@struct.dataclass
+class Messages:
+    """One tick's worth of RPC traffic, dense over (peer, group).
+
+    Axis 0 is the *sender* for an inbox and the *destination* for an outbox.
+    At most one RPC of each kind per (peer, group) per tick — the dense analog
+    of the reference's scope-multiplexed single connection per peer
+    (transport/NettyNode.java:54-74).
+
+    Covers the reference's full 4-RPC wire interface (RaftService.java:22-61):
+    appendEntries, preVote, requestVote, installSnapshot (+ replies).
+    """
+
+    # AppendEntries request (reference Leader.replicateLog → Follower.appendEntries)
+    ae_valid: jax.Array      # [P, G] bool
+    ae_term: jax.Array       # [P, G] int32
+    ae_prev_idx: jax.Array   # [P, G] int32
+    ae_prev_term: jax.Array  # [P, G] int32
+    ae_commit: jax.Array     # [P, G] int32 — leaderCommit
+    ae_n: jax.Array          # [P, G] int32 — entry count (<= B)
+    ae_ents: jax.Array       # [P, G, B] int32 — entry terms
+
+    # AppendEntries response (reference RaftResponse + match bookkeeping)
+    aer_valid: jax.Array     # [P, G] bool
+    aer_term: jax.Array      # [P, G] int32
+    aer_success: jax.Array   # [P, G] bool
+    aer_match: jax.Array     # [P, G] int32 — match index on success, nextIndex-1 hint on failure
+
+    # RequestVote / PreVote request (reference Follower.prepareElection,
+    # Candidate.startElection)
+    rv_valid: jax.Array      # [P, G] bool
+    rv_term: jax.Array       # [P, G] int32 (PreVote carries term+1 speculatively)
+    rv_last_idx: jax.Array   # [P, G] int32
+    rv_last_term: jax.Array  # [P, G] int32
+    rv_prevote: jax.Array    # [P, G] bool
+
+    # Vote response
+    rvr_valid: jax.Array     # [P, G] bool
+    rvr_term: jax.Array      # [P, G] int32 — responder's current term
+    rvr_granted: jax.Array   # [P, G] bool
+    rvr_prevote: jax.Array   # [P, G] bool
+    rvr_echo: jax.Array      # [P, G] int32 — echo of the requested term (staleness fence,
+                             #   the vectorized analog of AsyncHead request-group
+                             #   cancellation, transport/rpc/Async.java:70-172)
+
+    # InstallSnapshot request/response (reference Leader.java:168-190,
+    # Follower.installSnapshot:130-153).  Device plane carries only the
+    # milestone (index, term); bulk bytes move on the host side channel.
+    is_valid: jax.Array      # [P, G] bool
+    is_term: jax.Array       # [P, G] int32
+    is_idx: jax.Array        # [P, G] int32 — snapshot last index
+    is_last_term: jax.Array  # [P, G] int32 — snapshot last term
+    isr_valid: jax.Array     # [P, G] bool
+    isr_term: jax.Array      # [P, G] int32
+    isr_success: jax.Array   # [P, G] bool
+
+    @classmethod
+    def empty(cls, cfg: EngineConfig) -> "Messages":
+        P, G, B = cfg.n_peers, cfg.n_groups, cfg.batch
+        z = lambda *s: jnp.zeros(s, I32)
+        f = lambda *s: jnp.zeros(s, jnp.bool_)
+        return cls(
+            ae_valid=f(P, G), ae_term=z(P, G), ae_prev_idx=z(P, G),
+            ae_prev_term=z(P, G), ae_commit=z(P, G), ae_n=z(P, G),
+            ae_ents=z(P, G, B),
+            aer_valid=f(P, G), aer_term=z(P, G), aer_success=f(P, G),
+            aer_match=z(P, G),
+            rv_valid=f(P, G), rv_term=z(P, G), rv_last_idx=z(P, G),
+            rv_last_term=z(P, G), rv_prevote=f(P, G),
+            rvr_valid=f(P, G), rvr_term=z(P, G), rvr_granted=f(P, G),
+            rvr_prevote=f(P, G), rvr_echo=z(P, G),
+            is_valid=f(P, G), is_term=z(P, G), is_idx=z(P, G),
+            is_last_term=z(P, G),
+            isr_valid=f(P, G), isr_term=z(P, G), isr_success=f(P, G),
+        )
+
+
+@struct.dataclass
+class HostInbox:
+    """Host → device inputs for one tick (beyond peer RPC traffic)."""
+
+    submit_n: jax.Array        # [G] int32 — new client commands offered (<= S)
+    # Snapshot-install completion events (host finished downloading/restoring
+    # a snapshot; reference RaftRoutine.restoreCheckpoint:482-541).
+    snap_done: jax.Array       # [G] bool
+    snap_idx: jax.Array        # [G] int32
+    snap_term: jax.Array       # [G] int32
+    # Compaction grants: host took a snapshot at this index, device may raise
+    # the log floor (reference RaftRoutine.compactLog:365-400).  The milestone
+    # term is read from the device-side ring, so only the index is needed.
+    compact_to: jax.Array      # [G] int32 (0 = no-op)
+
+    @classmethod
+    def empty(cls, cfg: EngineConfig) -> "HostInbox":
+        G = cfg.n_groups
+        return cls(
+            submit_n=jnp.zeros((G,), I32),
+            snap_done=jnp.zeros((G,), jnp.bool_),
+            snap_idx=jnp.zeros((G,), I32),
+            snap_term=jnp.zeros((G,), I32),
+            compact_to=jnp.zeros((G,), I32),
+        )
+
+
+@struct.dataclass
+class StepInfo:
+    """Device → host outputs for one tick (beyond peer RPC traffic)."""
+
+    submit_start: jax.Array   # [G] int32 — first index assigned to accepted commands
+    submit_acc: jax.Array     # [G] int32 — how many offered commands were accepted
+    dirty: jax.Array          # [G] bool — (term, votedFor) or log tail changed; the
+                              #   host must fsync stable records / WAL before
+                              #   releasing this tick's outbox (the reference
+                              #   persists before replying, RaftMember.java:25)
+    appended_from: jax.Array  # [G] int32 — first index (re)written this tick (0 none)
+    appended_to: jax.Array    # [G] int32 — last index written this tick
+    commit: jax.Array         # [G] int32 — post-step commitIndex (apply frontier)
+    leader: jax.Array         # [G] int32 — leader hint for client redirect
+    snap_req: jax.Array       # [G] bool — follower should start a snapshot download
+    snap_req_from: jax.Array  # [G] int32 — peer to download from
+    snap_req_idx: jax.Array   # [G] int32
+    snap_req_term: jax.Array  # [G] int32
+
+    @classmethod
+    def empty(cls, cfg: EngineConfig) -> "StepInfo":
+        G = cfg.n_groups
+        z = lambda: jnp.zeros((G,), I32)
+        return cls(
+            submit_start=z(), submit_acc=z(),
+            dirty=jnp.zeros((G,), jnp.bool_),
+            appended_from=z(), appended_to=z(), commit=z(), leader=z(),
+            snap_req=jnp.zeros((G,), jnp.bool_),
+            snap_req_from=z(), snap_req_idx=z(), snap_req_term=z(),
+        )
+
+
+def init_state(cfg: EngineConfig, node_id: int, seed: int = 0,
+               n_active: int | None = None) -> RaftState:
+    """Fresh boot state: every group a follower at term 0 with an empty log.
+
+    The staggered election deadlines come from the per-group randomized
+    timeout, seeded per node — the vectorized analog of the reference's
+    randomized election window (support/RaftConfig.java:187-190).
+    """
+    G, P = cfg.n_groups, cfg.n_peers
+    key = jax.random.PRNGKey(seed * 7919 + node_id)
+    key, sub = jax.random.split(key)
+    first_deadline = jax.random.randint(
+        sub, (G,), cfg.election_ticks, 2 * cfg.election_ticks, dtype=I32)
+    active = jnp.arange(G) < (G if n_active is None else n_active)
+    z = lambda *s: jnp.zeros(s, I32)
+    return RaftState(
+        node_id=jnp.asarray(node_id, I32),
+        now=jnp.asarray(0, I32),
+        rng=key,
+        active=active,
+        term=z(G),
+        role=z(G),
+        voted_for=jnp.full((G,), NIL, I32),
+        leader_id=jnp.full((G,), NIL, I32),
+        commit=z(G),
+        applied=z(G),
+        log=LogState(term=z(G, cfg.log_slots), base=z(G), base_term=z(G), last=z(G)),
+        next_idx=jnp.ones((G, P), I32),
+        match_idx=z(G, P),
+        awaiting=jnp.zeros((G, P), jnp.bool_),
+        sent_at=z(G, P),
+        need_snap=jnp.zeros((G, P), jnp.bool_),
+        votes=jnp.zeros((G, P), jnp.bool_),
+        prevotes=jnp.zeros((G, P), jnp.bool_),
+        elect_deadline=first_deadline,
+        hb_due=z(G),
+    )
