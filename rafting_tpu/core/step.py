@@ -12,6 +12,18 @@ RaftRoutine.java:86-216) with data parallelism.  Semantics are kept faithful
 to the reference's Raft implementation; each phase cites the Java code whose
 behavior it vectorizes.
 
+Vectorization notes (why no per-peer sequential folds are needed):
+
+* AppendEntries / InstallSnapshot requests: only ONE peer can be the
+  current-term leader of a group (election safety), so after term sync at
+  most one inbound request per group passes the term check — selecting it
+  with an argmax over the peer axis is equivalent to processing peers in
+  order.
+* Responses (AE replies, vote replies): pure elementwise [G, P] updates.
+* Vote requests: grant exclusivity within a tick is the only order-dependent
+  rule; granting the lowest-indexed eligible requester reproduces the
+  sequential fold exactly.
+
 Phase order within a tick (messages produced in tick t are delivered in t+1):
   1. term sync           — step down on any higher inbound term
   2. vote requests       — grant PreVote/RequestVote, produce replies
@@ -64,7 +76,7 @@ def ring_term_at(log: LogState, idx: Array) -> Array:
 
 
 def ring_terms_batch(log: LogState, idx: Array) -> Array:
-    """Terms for a [G, B] index matrix (absent -> -1)."""
+    """Terms for a [G, K] index matrix (absent -> -1)."""
     L = log.term.shape[1]
     slot = jnp.remainder(idx, L)
     t = jnp.take_along_axis(log.term, slot, axis=1)
@@ -73,11 +85,27 @@ def ring_terms_batch(log: LogState, idx: Array) -> Array:
 
 
 def ring_write_batch(log_term: Array, idx: Array, vals: Array, mask: Array) -> Array:
-    """Masked scatter of entry terms at [G, B] indices into the [G, L] ring."""
+    """Masked scatter of entry terms at [G, K] indices into the [G, L] ring."""
     G, L = log_term.shape
     rows = jnp.broadcast_to(jnp.arange(G, dtype=I32)[:, None], idx.shape)
     slot = jnp.where(mask, jnp.remainder(idx, L), L)  # L = out of range -> dropped
     return log_term.at[rows, slot].set(vals, mode="drop")
+
+
+def _pick_peer(flag_pg: Array) -> Tuple[Array, Array]:
+    """Select the lowest-indexed peer whose flag is set, per group.
+
+    Returns (peer_index [G], any_flag [G])."""
+    any_f = flag_pg.any(axis=0)
+    return jnp.argmax(flag_pg, axis=0).astype(I32), any_f
+
+
+def _gather_peer(field_pg: Array, peer: Array) -> Array:
+    """field[[P, G] or [P, G, K]], peer [G] -> per-group selected [G] / [G, K]."""
+    if field_pg.ndim == 2:
+        return jnp.take_along_axis(field_pg, peer[None, :], axis=0)[0]
+    return jnp.take_along_axis(
+        field_pg, peer[None, :, None], axis=0)[0]
 
 
 # ---------------------------------------------------------------------------
@@ -99,8 +127,9 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
                                  2 * cfg.election_ticks, dtype=I32)
 
     me = s.node_id
-    peer_axis = jnp.arange(P, dtype=I32)
-    self_hot = peer_axis[None, :] == me          # [1, P] one-hot row for self
+    peer_ids = jnp.arange(P, dtype=I32)
+    self_hot = peer_ids[None, :] == me            # [1, P] one-hot row for self
+    not_me_col = (peer_ids != me)[:, None]        # [P, 1] mask over peer axis
 
     active = s.active
     term, role, voted = s.term, s.role, s.voted_for
@@ -139,53 +168,52 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     last_term_v = ring_term_at(log, log.last)
 
     # ---- 2. vote requests --------------------------------------------------
-    # Sequential fold over peers so at most one RequestVote is granted per
-    # term even when several arrive in the same tick (votedFor updates are
-    # visible to the next peer's evaluation).
-    rvr_valid_o, rvr_term_o, rvr_granted_o, rvr_prevote_o, rvr_echo_o = \
-        [], [], [], [], []
-    for p in range(P):
-        pid = jnp.asarray(p, I32)
-        v = inbox.rv_valid[p] & active & (pid != me)
-        pv = inbox.rv_prevote[p]
-        rterm = inbox.rv_term[p]
-        # Log up-to-date check (reference Follower.logUpToDate:193-207).
-        utd = ((inbox.rv_last_term[p] > last_term_v) |
-               ((inbox.rv_last_term[p] == last_term_v) &
-                (inbox.rv_last_idx[p] >= log.last)))
-        # RequestVote grant (reference Follower.requestVote:108-127): same
-        # term (sync already adopted any higher term), unburned ballot,
-        # up-to-date log.
-        grant_rv = (v & ~pv & (rterm == term) &
-                    ((voted == NIL) | (voted == pid)) & utd)
-        voted = jnp.where(grant_rv, pid, voted)
-        elect_dl = jnp.where(grant_rv, now + rand_to, elect_dl)
-        # PreVote grant (reference Follower.preVote:91-105): only if we
-        # ourselves have detected leader silence (lease), log up-to-date and
-        # the speculative term is ahead.  No durable state changes.
-        lease_open = (now >= elect_dl) | (leader_id == NIL)
-        grant_pv = v & pv & (rterm > term) & utd & lease_open
-        rvr_valid_o.append(v)
-        rvr_term_o.append(term)
-        rvr_granted_o.append(jnp.where(pv, grant_pv, grant_rv))
-        rvr_prevote_o.append(pv)
-        rvr_echo_o.append(rterm)
+    # (reference Follower.requestVote:108-127 / preVote:91-105.)
+    rv_v = inbox.rv_valid & active[None, :] & not_me_col          # [P, G]
+    pv = inbox.rv_prevote
+    # Log up-to-date check (reference Follower.logUpToDate:193-207).
+    utd = ((inbox.rv_last_term > last_term_v[None, :]) |
+           ((inbox.rv_last_term == last_term_v[None, :]) &
+            (inbox.rv_last_idx >= log.last[None, :])))
+    # RequestVote eligibility: same term (sync already adopted any higher),
+    # ballot unburned or already ours.
+    elig_rv = (rv_v & ~pv & (inbox.rv_term == term[None, :]) & utd &
+               ((voted[None, :] == NIL) | (voted[None, :] == peer_ids[:, None])))
+    # Exclusivity: grant the lowest-indexed eligible requester (== the
+    # sequential fold order).  Re-grants to the peer we already voted for
+    # are always allowed.
+    first_elig, _ = _pick_peer(elig_rv)
+    grant_rv = elig_rv & ((voted[None, :] == peer_ids[:, None]) |
+                          (peer_ids[:, None] == first_elig[None, :]))
+    granted_any = (grant_rv & (voted[None, :] == NIL)).any(axis=0)
+    voted = jnp.where(granted_any & (voted == NIL), first_elig, voted)
+    elect_dl = jnp.where(grant_rv.any(axis=0), now + rand_to, elect_dl)
+    # PreVote grant (reference Follower.preVote:91-105): only if we ourselves
+    # have detected leader silence (lease), log up-to-date, term ahead.  No
+    # durable state changes.
+    lease_open = (now >= elect_dl) | (leader_id == NIL)
+    grant_pv = (rv_v & pv & (inbox.rv_term > term[None, :]) & utd &
+                lease_open[None, :])
+    out_rvr_valid = rv_v
+    out_rvr_term = jnp.broadcast_to(term[None, :], (P, G))
+    out_rvr_granted = jnp.where(pv, grant_pv, grant_rv)
+    out_rvr_prevote = pv
+    out_rvr_echo = inbox.rv_term
 
     # ---- 3. vote responses + tallies --------------------------------------
-    for p in range(P):
-        r = inbox.rvr_valid[p] & active
-        # PreVote tally: accept grants only for the round we are still in —
-        # the echoed requested term must equal term+1 (vectorized analog of
-        # AsyncHead cancellation of stale rounds, Async.java:70-172).
-        g_pv = (r & inbox.rvr_prevote[p] & inbox.rvr_granted[p] &
-                (role == PRE_CANDIDATE) & (inbox.rvr_echo[p] == term + 1))
-        prevotes = prevotes.at[:, p].set(prevotes[:, p] | g_pv)
-        # Real vote tally (reference Candidate.startElection:112-134): a
-        # grant implies the responder adopted our term, so term equality is
-        # the staleness fence.
-        g_rv = (r & ~inbox.rvr_prevote[p] & inbox.rvr_granted[p] &
-                (role == CANDIDATE) & (inbox.rvr_term[p] == term))
-        votes = votes.at[:, p].set(votes[:, p] | g_rv)
+    rr = inbox.rvr_valid & active[None, :]
+    # PreVote tally: accept grants only for the round we are still in — the
+    # echoed requested term must equal term+1 (vectorized analog of AsyncHead
+    # cancellation of stale rounds, Async.java:70-172).
+    g_pv = (rr & inbox.rvr_prevote & inbox.rvr_granted &
+            (role == PRE_CANDIDATE)[None, :] &
+            (inbox.rvr_echo == (term + 1)[None, :]))
+    prevotes = prevotes | g_pv.T
+    # Real vote tally (reference Candidate.startElection:112-134): a grant
+    # implies the responder adopted our term, so term equality is the fence.
+    g_rv = (rr & ~inbox.rvr_prevote & inbox.rvr_granted &
+            (role == CANDIDATE)[None, :] & (inbox.rvr_term == term[None, :]))
+    votes = votes | g_rv.T
 
     maj = jnp.asarray(cfg.majority, I32)
     pv_win = (role == PRE_CANDIDATE) & (prevotes.sum(axis=1) >= maj)
@@ -213,116 +241,117 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
 
     # ---- 4. AppendEntries requests ----------------------------------------
     # (reference Follower.appendEntries:35-88 — consistency check, conflict
-    # truncation, append, passive commit.)
-    aer_valid_o, aer_term_o, aer_success_o, aer_match_o = [], [], [], []
-    app_from = jnp.zeros((G,), I32)
-    app_to = jnp.zeros((G,), I32)
+    # truncation, append, passive commit.)  At most one inbound AE per group
+    # passes the term check (single current-term leader), so we select it
+    # with an argmax and process all groups at once.
+    ae_v = inbox.ae_valid & active[None, :] & not_me_col
+    ae_t_ok = ae_v & (inbox.ae_term == term[None, :])
+    ae_peer, ae_any = _pick_peer(ae_t_ok)
+    # A valid leader at our term: candidates/pre-candidates step down
+    # (reference Candidate.appendEntries:28-41); election timer resets
+    # (Follower.java:43).  A same-term leader receiving an AE is impossible
+    # under election safety — guard so it never demotes itself.
+    ae_any = ae_any & (role != LEADER)
+    role = jnp.where(ae_any, FOLLOWER, role)
+    leader_id = jnp.where(ae_any, ae_peer, leader_id)
+    elect_dl = jnp.where(ae_any, now + rand_to, elect_dl)
+
+    prev_i = _gather_peer(inbox.ae_prev_idx, ae_peer)
+    prev_t = _gather_peer(inbox.ae_prev_term, ae_peer)
+    n_e = _gather_peer(inbox.ae_n, ae_peer)
+    lc = _gather_peer(inbox.ae_commit, ae_peer)
+    ents = _gather_peer(inbox.ae_ents, ae_peer)                  # [G, B]
+    # Consistency: prev entry matches, or prev is at/under our compaction
+    # floor (compacted == committed == matched; reference
+    # Follower.logContains:177-191 + purgeEntries:209-221).
+    prev_match = ((prev_i <= log.base) |
+                  ((prev_i <= log.last) & (ring_term_at(log, prev_i) == prev_t)))
+    acc = ae_any & prev_match
+
     col = jnp.arange(B, dtype=I32)[None, :]
-    for p in range(P):
-        pid = jnp.asarray(p, I32)
-        v = inbox.ae_valid[p] & active & (pid != me)
-        t_ok = v & (inbox.ae_term[p] == term)
-        # A valid leader at our term: candidates/pre-candidates step down
-        # (reference Candidate.appendEntries:28-41); election timer resets
-        # (Follower.java:43).
-        role = jnp.where(t_ok & (role != LEADER), FOLLOWER, role)
-        leader_id = jnp.where(t_ok, pid, leader_id)
-        elect_dl = jnp.where(t_ok, now + rand_to, elect_dl)
-
-        prev_i = inbox.ae_prev_idx[p]
-        n_e = inbox.ae_n[p]
-        # Consistency: prev entry matches, or prev is at/under our compaction
-        # floor (compacted == committed == matched; reference
-        # Follower.logContains:177-191 + purgeEntries:209-221).
-        prev_match = ((prev_i <= log.base) |
-                      ((prev_i <= log.last) &
-                       (ring_term_at(log, prev_i) == inbox.ae_prev_term[p])))
-        acc = t_ok & prev_match
-
-        idxs = prev_i[:, None] + 1 + col                       # [G, B]
-        ents = inbox.ae_ents[p]
-        in_n = col < n_e[:, None]
-        exists = (idxs <= log.last[:, None]) & (idxs > log.base[:, None])
-        cur = ring_terms_batch(log, idxs)
-        conflict = (acc[:, None] & in_n & exists & (cur != ents)).any(axis=1)
-        wmask = acc[:, None] & in_n & (idxs > log.base[:, None])
-        new_term_ring = ring_write_batch(log.term, idxs, ents, wmask)
-        tail = prev_i + n_e
-        # Conflict => truncate-then-append == overwrite + last = prev+n;
-        # no conflict => never shrink (stale/duplicate RPC; reference
-        # RocksLog.conflict:199-216 + truncate:219-225 + append:169-196).
-        new_last = jnp.where(acc,
-                             jnp.where(conflict, tail,
-                                       jnp.maximum(log.last, tail)),
-                             log.last)
-        wrote = acc & (n_e > 0) & ((new_last != log.last) | conflict)
-        app_from = jnp.where(wrote & (app_from == 0), prev_i + 1,
-                             jnp.where(wrote, jnp.minimum(app_from, prev_i + 1),
-                                       app_from))
-        app_to = jnp.where(wrote, jnp.maximum(app_to, new_last), app_to)
-        log = log.replace(term=new_term_ring, last=new_last)
-        # Passive commit (reference Follower.java:76-82): min(leaderCommit,
-        # last new entry), monotone.
-        commit = jnp.where(acc,
-                           jnp.maximum(commit,
-                                       jnp.minimum(inbox.ae_commit[p], new_last)),
-                           commit)
-        # Reply: success carries the new match point; failure carries a
-        # nextIndex hint = min(our last, prev-1) — an accelerated version of
-        # the reference's log-scaled backoff (Leadership.updateIndex:75-114).
-        aer_valid_o.append(v)
-        aer_term_o.append(term)
-        aer_success_o.append(acc)
-        aer_match_o.append(jnp.where(acc, tail,
-                                     jnp.minimum(log.last, prev_i - 1)))
+    idxs = prev_i[:, None] + 1 + col                             # [G, B]
+    in_n = col < n_e[:, None]
+    exists = (idxs <= log.last[:, None]) & (idxs > log.base[:, None])
+    cur = ring_terms_batch(log, idxs)
+    conflict = (acc[:, None] & in_n & exists & (cur != ents)).any(axis=1)
+    wmask = acc[:, None] & in_n & (idxs > log.base[:, None])
+    new_ring = ring_write_batch(log.term, idxs, ents, wmask)
+    tail = prev_i + n_e
+    # Conflict => truncate-then-append == overwrite + last = prev+n;
+    # no conflict => never shrink (stale/duplicate RPC; reference
+    # RocksLog.conflict:199-216 + truncate:219-225 + append:169-196).
+    new_last = jnp.where(acc,
+                         jnp.where(conflict, tail,
+                                   jnp.maximum(log.last, tail)),
+                         log.last)
+    wrote = acc & (n_e > 0) & ((new_last != log.last) | conflict)
+    app_from = jnp.where(wrote, prev_i + 1, jnp.zeros((G,), I32))
+    app_to = jnp.where(wrote, new_last, jnp.zeros((G,), I32))
+    log = log.replace(term=new_ring, last=new_last)
+    # Passive commit (reference Follower.java:76-82), bounded by the
+    # *verified* prefix prev+n — not our log tail, which may still hold an
+    # unverified divergent suffix from a deposed leader (Raft fig. 2:
+    # min(leaderCommit, index of last NEW entry)).
+    commit = jnp.where(acc,
+                       jnp.maximum(commit, jnp.minimum(lc, tail)),
+                       commit)
+    # Replies to every valid AE: the selected peer gets the real verdict;
+    # stale-term senders get failure at our (newer) term.  Failure carries a
+    # nextIndex hint = min(our last, prev-1) — an accelerated version of the
+    # reference's log-scaled backoff (Leadership.updateIndex:75-114).
+    is_sel = (peer_ids[:, None] == ae_peer[None, :]) & ae_t_ok
+    out_aer_valid = ae_v
+    out_aer_term = jnp.broadcast_to(term[None, :], (P, G))
+    out_aer_success = is_sel & acc[None, :]
+    out_aer_match = jnp.where(
+        is_sel & acc[None, :], tail[None, :],
+        jnp.minimum(log.last[None, :], inbox.ae_prev_idx - 1))
 
     # ---- 5. InstallSnapshot ------------------------------------------------
     # Device plane: an offer merely tells the follower's host to start the
     # bulk download (side channel, reference EventNode.SnapChannel:122-267).
-    # The host reports completion via HostInbox.snap_done, at which point the
-    # log floor jumps to the milestone (reference
-    # RaftRoutine.accomplishInstallation:451-475 — log.flush(milestone)).
-    snap_req = jnp.zeros((G,), jnp.bool_)
-    snap_from = jnp.zeros((G,), I32)
-    snap_idx_o = jnp.zeros((G,), I32)
-    snap_term_o = jnp.zeros((G,), I32)
-    isr_valid_o, isr_term_o, isr_success_o = [], [], []
-    for p in range(P):
-        pid = jnp.asarray(p, I32)
-        v = inbox.is_valid[p] & active & (pid != me)
-        t_ok = v & (inbox.is_term[p] == term)
-        role = jnp.where(t_ok & (role != LEADER), FOLLOWER, role)
-        leader_id = jnp.where(t_ok, pid, leader_id)
-        elect_dl = jnp.where(t_ok, now + rand_to, elect_dl)
-        # Success only once the milestone is covered: either our snapshot
-        # floor already includes it, or we hold a matching entry at that
-        # index.  While the bulk download is still in flight we answer
-        # failure so the leader keeps the installation pending (reference
-        # PendingSnapshot tracking, SnapshotArchive.java:197-211).
-        covered = ((inbox.is_idx[p] <= log.base) |
-                   ((inbox.is_idx[p] <= log.last) &
-                    (ring_term_at(log, inbox.is_idx[p]) ==
-                     inbox.is_last_term[p])))
-        useful = t_ok & ~covered
-        snap_req = snap_req | useful
-        snap_from = jnp.where(useful, pid, snap_from)
-        snap_idx_o = jnp.where(useful, inbox.is_idx[p], snap_idx_o)
-        snap_term_o = jnp.where(useful, inbox.is_last_term[p], snap_term_o)
-        isr_valid_o.append(v)
-        isr_term_o.append(term)
-        isr_success_o.append(t_ok & covered)
+    # The host reports completion via HostInbox.snap_done (reference
+    # RaftRoutine.restoreCheckpoint:482-541).
+    is_v = inbox.is_valid & active[None, :] & not_me_col
+    is_t_ok = is_v & (inbox.is_term == term[None, :])
+    is_peer, is_any = _pick_peer(is_t_ok)
+    is_any = is_any & (role != LEADER)
+    role = jnp.where(is_any, FOLLOWER, role)
+    leader_id = jnp.where(is_any, is_peer, leader_id)
+    elect_dl = jnp.where(is_any, now + rand_to, elect_dl)
+    off_idx = _gather_peer(inbox.is_idx, is_peer)
+    off_term = _gather_peer(inbox.is_last_term, is_peer)
+    # Success only once the milestone is covered: either our snapshot floor
+    # already includes it, or we hold a matching entry at that index.  While
+    # the bulk download is in flight we answer failure so the leader keeps
+    # the installation pending (reference PendingSnapshot tracking,
+    # SnapshotArchive.java:197-211).
+    covered = ((off_idx <= log.base) |
+               ((off_idx <= log.last) &
+                (ring_term_at(log, off_idx) == off_term)))
+    useful = is_any & ~covered
+    snap_req = useful
+    snap_from = jnp.where(useful, is_peer, 0)
+    snap_idx_o = jnp.where(useful, off_idx, 0)
+    snap_term_o = jnp.where(useful, off_term, 0)
+    is_sel_snap = (peer_ids[:, None] == is_peer[None, :]) & is_t_ok
+    out_isr_valid = is_v
+    out_isr_term = jnp.broadcast_to(term[None, :], (P, G))
+    out_isr_success = is_sel_snap & covered[None, :]
 
     # Host finished installing a snapshot: adopt the milestone as the new
-    # log floor (truncating everything) and move commit/applied up.
+    # log floor.  InstallSnapshot receiver rule (Raft fig. 13): if we hold an
+    # entry matching the snapshot's (lastIndex, lastTerm), retain the suffix
+    # after it; otherwise the whole log is suspect — discard it.
     sd = host.snap_done & active & (host.snap_idx > log.base)
+    tail_matches = ((host.snap_idx <= log.last) &
+                    (ring_term_at(log, host.snap_idx) == host.snap_term))
     log = log.replace(
         base=jnp.where(sd, host.snap_idx, log.base),
         base_term=jnp.where(sd, host.snap_term, log.base_term),
-        last=jnp.where(sd, jnp.maximum(log.last, host.snap_idx), log.last),
+        last=jnp.where(sd, jnp.where(tail_matches, log.last, host.snap_idx),
+                       log.last),
     )
-    # Entries between old base and the milestone are gone; if our last was
-    # behind the milestone the ring holds nothing live beyond it.
-    log = log.replace(last=jnp.where(sd & (log.last < log.base), log.base, log.last))
     commit = jnp.where(sd, jnp.maximum(commit, host.snap_idx), commit)
 
     # Compaction grant from host (snapshot taken at compact_to): raise floor,
@@ -336,42 +365,39 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
                       base_term=jnp.where(do_c, ct_term, log.base_term))
 
     # ---- 6. AppendEntries responses (leader bookkeeping) -------------------
-    # (reference Leader reply handling, Leader.java:224-243 +
-    # Leadership.State.updateIndex:75-114.)
-    for p in range(P):
-        r = inbox.aer_valid[p] & active & (role == LEADER) & \
-            (inbox.aer_term[p] == term)
-        suc = r & inbox.aer_success[p]
-        fail = r & ~inbox.aer_success[p]
-        m_new = jnp.maximum(match_idx[:, p], inbox.aer_match[p])
-        match_idx = match_idx.at[:, p].set(jnp.where(suc, m_new, match_idx[:, p]))
-        nx = jnp.where(suc, jnp.maximum(next_idx[:, p], m_new + 1),
-                       jnp.where(fail,
-                                 jnp.clip(inbox.aer_match[p] + 1, 1, next_idx[:, p]),
-                                 next_idx[:, p]))
-        # Follower fell below our compaction floor -> needs a snapshot
-        # (reference Leadership.java:111-113 pendingInstallation trigger).
-        ns = fail & (nx <= log.base)
-        need_snap = need_snap.at[:, p].set(jnp.where(r, ns, need_snap[:, p]))
-        next_idx = next_idx.at[:, p].set(jnp.maximum(nx, log.base + 1))
-        awaiting = awaiting.at[:, p].set(jnp.where(r, False, awaiting[:, p]))
+    # (reference Leader.java:224-243 + Leadership.State.updateIndex:75-114.)
+    # Pure elementwise [G, P] updates.
+    aer_r = (inbox.aer_valid & active[None, :] & (role == LEADER)[None, :] &
+             (inbox.aer_term == term[None, :])).T                # [G, P]
+    aer_suc = aer_r & inbox.aer_success.T
+    aer_fail = aer_r & ~inbox.aer_success.T
+    aer_m = inbox.aer_match.T
+    m_new = jnp.maximum(match_idx, aer_m)
+    match_idx = jnp.where(aer_suc, m_new, match_idx)
+    nx = jnp.where(aer_suc, jnp.maximum(next_idx, m_new + 1),
+                   jnp.where(aer_fail,
+                             jnp.clip(aer_m + 1, 1, next_idx), next_idx))
+    # Follower fell below our compaction floor -> needs a snapshot
+    # (reference Leadership.java:111-113 pendingInstallation trigger).
+    need_snap = jnp.where(aer_r, aer_fail & (nx <= log.base[:, None]),
+                          need_snap)
+    next_idx = jnp.maximum(nx, log.base[:, None] + 1)
+    awaiting = jnp.where(aer_r, False, awaiting)
 
     # Snapshot response: success means the follower now covers our offered
     # milestone — resume log replication from just past our floor (reference
     # accomplishInstallation -> normal AppendEntries flow,
     # RaftRoutine.java:451-475).  Failure = still downloading; keep pending.
-    for p in range(P):
-        r = inbox.isr_valid[p] & active & (role == LEADER) & \
-            (inbox.isr_term[p] == term)
-        ok = r & inbox.isr_success[p]
-        need_snap = need_snap.at[:, p].set(jnp.where(ok, False, need_snap[:, p]))
-        next_idx = next_idx.at[:, p].set(
-            jnp.where(ok, jnp.maximum(next_idx[:, p], log.base + 1),
-                      next_idx[:, p]))
-        match_idx = match_idx.at[:, p].set(
-            jnp.where(ok, jnp.maximum(match_idx[:, p], log.base),
-                      match_idx[:, p]))
-        awaiting = awaiting.at[:, p].set(jnp.where(r, False, awaiting[:, p]))
+    isr_r = (inbox.isr_valid & active[None, :] & (role == LEADER)[None, :] &
+             (inbox.isr_term == term[None, :])).T                # [G, P]
+    isr_ok = isr_r & inbox.isr_success.T
+    need_snap = jnp.where(isr_ok, False, need_snap)
+    next_idx = jnp.where(isr_ok,
+                         jnp.maximum(next_idx, log.base[:, None] + 1),
+                         next_idx)
+    match_idx = jnp.where(isr_ok, jnp.maximum(match_idx, log.base[:, None]),
+                          match_idx)
+    awaiting = jnp.where(isr_r, False, awaiting)
 
     # ---- 7. timers ---------------------------------------------------------
     # (reference RaftRoutine.electionTimeout:65-77 -> Follower.onTimeout:
@@ -403,8 +429,9 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     n_acc = jnp.where(active & (role == LEADER),
                       jnp.clip(host.submit_n, 0, jnp.minimum(free, S)), 0)
     sub_start = log.last + 1
-    sidx = log.last[:, None] + 1 + jnp.arange(S, dtype=I32)[None, :]
-    smask = jnp.arange(S, dtype=I32)[None, :] < n_acc[:, None]
+    scol = jnp.arange(S, dtype=I32)[None, :]
+    sidx = log.last[:, None] + 1 + scol
+    smask = scol < n_acc[:, None]
     new_ring = ring_write_batch(log.term, sidx,
                                 jnp.broadcast_to(term[:, None], (G, S)), smask)
     log = log.replace(term=new_ring, last=log.last + n_acc)
@@ -415,55 +442,46 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     # (reference Leader.replicateLog:142-245 — the hot loop, now a dense
     # (group x peer) batch build straight from the HBM ring.)
     heartbeat = (role == LEADER) & (now >= hb_due)
-    ae_valid_o, ae_term_o, ae_prev_o, ae_pterm_o, ae_commit_o, ae_n_o, \
-        ae_ents_o = [], [], [], [], [], [], []
-    is_valid_o2, is_term_o2, is_idx_o2, is_lterm_o2 = [], [], [], []
-    for p in range(P):
-        pid = jnp.asarray(p, I32)
-        is_peer = (pid != me)
-        nx = next_idx[:, p]
-        n_avail = jnp.clip(log.last - nx + 1, 0, B)
-        has_data = (log.last >= nx) & ~need_snap[:, p]
-        resend_ok = (~awaiting[:, p]) | (now - sent_at[:, p] >=
-                                         cfg.rpc_timeout_ticks)
-        send_ae = (active & (role == LEADER) & is_peer & ~need_snap[:, p] &
-                   resend_ok & (has_data | heartbeat))
-        n_send = jnp.where(has_data, n_avail, 0)
-        prev = nx - 1
-        ents = ring_terms_batch(log, nx[:, None] + col)
-        ae_valid_o.append(send_ae)
-        ae_term_o.append(term)
-        ae_prev_o.append(prev)
-        ae_pterm_o.append(ring_term_at(log, prev))
-        ae_commit_o.append(commit)
-        ae_n_o.append(n_send)
-        ae_ents_o.append(ents)
-        # Snapshot offer for laggards (reference Leader.java:168-190).
-        send_is = (active & (role == LEADER) & is_peer & need_snap[:, p] &
-                   resend_ok)
-        is_valid_o2.append(send_is)
-        is_term_o2.append(term)
-        is_idx_o2.append(log.base)
-        is_lterm_o2.append(log.base_term)
-        sent = send_ae | send_is
-        awaiting = awaiting.at[:, p].set(jnp.where(sent & (has_data | send_is),
-                                                   True, awaiting[:, p]))
-        sent_at = sent_at.at[:, p].set(jnp.where(sent, now, sent_at[:, p]))
+    n_avail = jnp.clip(log.last[:, None] - next_idx + 1, 0, B)   # [G, P]
+    has_data = (log.last[:, None] >= next_idx) & ~need_snap
+    resend_ok = (~awaiting) | (now - sent_at >= cfg.rpc_timeout_ticks)
+    lead_peer = (active & (role == LEADER))[:, None] & ~self_hot
+    send_ae = (lead_peer & ~need_snap & resend_ok &
+               (has_data | heartbeat[:, None]))                  # [G, P]
+    n_send = jnp.where(has_data, n_avail, 0)
+    prev = next_idx - 1
+    # One fused gather for all peers' batches: [G, P*B] -> [P, G, B].
+    flat_idx = (next_idx[:, :, None] + col[None, :, :]).reshape(G, P * B)
+    ents_all = ring_terms_batch(log, flat_idx).reshape(G, P, B)
+    prev_terms = ring_terms_batch(log, prev).T                   # [P, G]
+    out_ae_valid = send_ae.T
+    out_ae_term = jnp.broadcast_to(term[None, :], (P, G))
+    out_ae_prev_idx = prev.T
+    out_ae_prev_term = prev_terms
+    out_ae_commit = jnp.broadcast_to(commit[None, :], (P, G))
+    out_ae_n = n_send.T
+    out_ae_ents = jnp.swapaxes(ents_all, 0, 1)                   # [P, G, B]
+    # Snapshot offer for laggards (reference Leader.java:168-190).
+    send_is = lead_peer & need_snap & resend_ok
+    out_is_valid = send_is.T
+    out_is_term = jnp.broadcast_to(term[None, :], (P, G))
+    out_is_idx = jnp.broadcast_to(log.base[None, :], (P, G))
+    out_is_last_term = jnp.broadcast_to(log.base_term[None, :], (P, G))
+    sent = send_ae | send_is
+    awaiting = jnp.where((send_ae & has_data) | send_is, True, awaiting)
+    sent_at = jnp.where(sent, now, sent_at)
     hb_due = jnp.where(heartbeat, now + cfg.heartbeat_ticks, hb_due)
 
     # Election broadcasts (PreVote at speculative term+1 carrying our log
-    # position, reference Follower.prepareElection:223-279; RequestVote at the
-    # new term, Candidate.startElection:90-143).
-    rv_valid_o, rv_term_o, rv_lidx_o, rv_lterm_o, rv_pv_o = [], [], [], [], []
-    for p in range(P):
-        pid = jnp.asarray(p, I32)
-        is_peer = (pid != me)
-        v = (became_cand | start_pre) & is_peer & active
-        rv_valid_o.append(v)
-        rv_term_o.append(jnp.where(start_pre, term + 1, term))
-        rv_lidx_o.append(log.last)
-        rv_lterm_o.append(last_term_v)
-        rv_pv_o.append(start_pre)
+    # position, reference Follower.prepareElection:223-279; RequestVote at
+    # the new term, Candidate.startElection:90-143).
+    bcast = (became_cand | start_pre) & active
+    out_rv_valid = bcast[None, :] & not_me_col
+    out_rv_term = jnp.broadcast_to(
+        jnp.where(start_pre, term + 1, term)[None, :], (P, G))
+    out_rv_last_idx = jnp.broadcast_to(log.last[None, :], (P, G))
+    out_rv_last_term = jnp.broadcast_to(last_term_v[None, :], (P, G))
+    out_rv_prevote = jnp.broadcast_to(start_pre[None, :], (P, G))
 
     # ---- 10. commit advance ------------------------------------------------
     # Quorum median over the match matrix with self = last (reference
@@ -475,7 +493,7 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     can_commit = (active & (role == LEADER) & (quorum_idx > commit) &
                   (ring_term_at(log, quorum_idx) == term))
     commit = jnp.where(can_commit, quorum_idx, commit)
-    match_idx = jnp.where(self_hot, log.last[:, None], match_idx)
+    match_idx = match_full
 
     dirty = (term != old_term) | (voted != old_voted) | (log.last != old_last) \
         | (app_to > 0)
@@ -489,27 +507,27 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         elect_deadline=elect_dl, hb_due=hb_due,
     )
     outbox = Messages(
-        ae_valid=jnp.stack(ae_valid_o), ae_term=jnp.stack(ae_term_o),
-        ae_prev_idx=jnp.stack(ae_prev_o), ae_prev_term=jnp.stack(ae_pterm_o),
-        ae_commit=jnp.stack(ae_commit_o), ae_n=jnp.stack(ae_n_o),
-        ae_ents=jnp.stack(ae_ents_o),
-        aer_valid=jnp.stack(aer_valid_o), aer_term=jnp.stack(aer_term_o),
-        aer_success=jnp.stack(aer_success_o), aer_match=jnp.stack(aer_match_o),
-        rv_valid=jnp.stack(rv_valid_o), rv_term=jnp.stack(rv_term_o),
-        rv_last_idx=jnp.stack(rv_lidx_o), rv_last_term=jnp.stack(rv_lterm_o),
-        rv_prevote=jnp.stack(rv_pv_o),
-        rvr_valid=jnp.stack(rvr_valid_o), rvr_term=jnp.stack(rvr_term_o),
-        rvr_granted=jnp.stack(rvr_granted_o),
-        rvr_prevote=jnp.stack(rvr_prevote_o), rvr_echo=jnp.stack(rvr_echo_o),
-        is_valid=jnp.stack(is_valid_o2), is_term=jnp.stack(is_term_o2),
-        is_idx=jnp.stack(is_idx_o2), is_last_term=jnp.stack(is_lterm_o2),
-        isr_valid=jnp.stack(isr_valid_o), isr_term=jnp.stack(isr_term_o),
-        isr_success=jnp.stack(isr_success_o),
+        ae_valid=out_ae_valid, ae_term=out_ae_term,
+        ae_prev_idx=out_ae_prev_idx, ae_prev_term=out_ae_prev_term,
+        ae_commit=out_ae_commit, ae_n=out_ae_n, ae_ents=out_ae_ents,
+        aer_valid=out_aer_valid, aer_term=out_aer_term,
+        aer_success=out_aer_success, aer_match=out_aer_match,
+        rv_valid=out_rv_valid, rv_term=out_rv_term,
+        rv_last_idx=out_rv_last_idx, rv_last_term=out_rv_last_term,
+        rv_prevote=out_rv_prevote,
+        rvr_valid=out_rvr_valid, rvr_term=out_rvr_term,
+        rvr_granted=out_rvr_granted, rvr_prevote=out_rvr_prevote,
+        rvr_echo=out_rvr_echo,
+        is_valid=out_is_valid, is_term=out_is_term, is_idx=out_is_idx,
+        is_last_term=out_is_last_term,
+        isr_valid=out_isr_valid, isr_term=out_isr_term,
+        isr_success=out_isr_success,
     )
     info = StepInfo(
         submit_start=sub_start, submit_acc=n_acc, dirty=dirty,
-        appended_from=app_from, appended_to=app_to, commit=commit,
-        leader=leader_id, snap_req=snap_req, snap_req_from=snap_from,
-        snap_req_idx=snap_idx_o, snap_req_term=snap_term_o,
+        appended_from=app_from, appended_to=app_to, log_tail=log.last,
+        commit=commit, leader=leader_id, snap_req=snap_req,
+        snap_req_from=snap_from, snap_req_idx=snap_idx_o,
+        snap_req_term=snap_term_o,
     )
     return new_state, outbox, info

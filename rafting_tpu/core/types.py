@@ -247,6 +247,10 @@ class StepInfo:
                               #   persists before replying, RaftMember.java:25)
     appended_from: jax.Array  # [G] int32 — first index (re)written this tick (0 none)
     appended_to: jax.Array    # [G] int32 — last index written this tick
+    log_tail: jax.Array       # [G] int32 — post-step log end: the host WAL's
+                              #   validity watermark.  Entries beyond it were
+                              #   truncated (conflict or snapshot discard) and
+                              #   must not survive recovery.
     commit: jax.Array         # [G] int32 — post-step commitIndex (apply frontier)
     leader: jax.Array         # [G] int32 — leader hint for client redirect
     snap_req: jax.Array       # [G] bool — follower should start a snapshot download
@@ -261,7 +265,8 @@ class StepInfo:
         return cls(
             submit_start=z(), submit_acc=z(),
             dirty=jnp.zeros((G,), jnp.bool_),
-            appended_from=z(), appended_to=z(), commit=z(), leader=z(),
+            appended_from=z(), appended_to=z(), log_tail=z(),
+            commit=z(), leader=jnp.full((G,), NIL, I32),
             snap_req=jnp.zeros((G,), jnp.bool_),
             snap_req_from=z(), snap_req_idx=z(), snap_req_term=z(),
         )

@@ -1,6 +1,10 @@
-"""Test configuration: force an 8-device virtual CPU platform BEFORE jax
-imports, so multi-chip sharding tests run anywhere (the driver's real TPU is
-single-chip; multi-chip is validated on a virtual mesh)."""
+"""Test configuration: force an 8-device virtual CPU platform so multi-node
+sharding tests run anywhere (the driver's real TPU is single-chip; multi-chip
+is validated on a virtual mesh).
+
+Note: the environment's sitecustomize may import jax at interpreter start and
+pin the platform config, so setting JAX_PLATFORMS in os.environ is not
+enough — the config must be updated programmatically as well."""
 
 import os
 
@@ -8,3 +12,7 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
