@@ -261,6 +261,14 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     n_e = _gather_peer(inbox.ae_n, ae_peer)
     lc = _gather_peer(inbox.ae_commit, ae_peer)
     ents = _gather_peer(inbox.ae_ents, ae_peer)                  # [G, B]
+    # Bounded-window partial accept: the live window (base, last] must never
+    # exceed the ring capacity L, or new entries would alias committed slots.
+    # A follower whose compaction floor lags the leader's clamps the batch to
+    # what fits; the success reply's match=tail makes the leader resume from
+    # the clamped point, and the commit/compact cycle frees capacity.  (No
+    # reference analog — RocksDB logs are unbounded; this is the flow-control
+    # rule the HBM-resident ring requires.)
+    n_e = jnp.clip(n_e, 0, jnp.maximum(log.base + L - prev_i, 0))
     # Consistency: prev entry matches, or prev is at/under our compaction
     # floor (compacted == committed == matched; reference
     # Follower.logContains:177-191 + purgeEntries:209-221).

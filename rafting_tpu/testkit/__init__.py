@@ -1,0 +1,16 @@
+"""Test kit: scalar oracle, cluster invariant checkers, chaos harness.
+
+The reference's test strategy (SURVEY.md §4) relies on (a) runtime
+AssertionError invariants saturating the main code, (b) a 3-node
+kill/restart procedure whose oracle is byte-identical output files.  Here
+those become first-class, automated components:
+
+* :mod:`oracle` — a scalar, loop-based re-derivation of the Raft tick
+  semantics, compared lane-for-lane against the vectorized kernel
+  (election-safety parity requirement, BASELINE.md).
+* :mod:`invariants` — cluster-level protocol invariants (election safety,
+  log matching, commit stability) checked over live histories.
+"""
+
+from .oracle import oracle_step  # noqa: F401
+from .invariants import ClusterChecker  # noqa: F401

@@ -1,0 +1,134 @@
+"""Cluster-level Raft protocol invariants, checked over live histories.
+
+The reference encodes its invariants as ~30 runtime ``AssertionError``s
+scattered through the hot path (e.g. one-leader-per-term,
+Follower.java:48-50 / Leader.java:79-81; monotonic matchIndex,
+Leadership.java:76-81; log continuity, RocksLog.java:175-187).  Here they
+are lifted into an external checker that audits full cluster snapshots
+between ticks — usable both in unit tests and in the chaos/fuzz harness
+(BASELINE.md configs 2-5).
+
+Checked invariants (Raft paper §5.2-§5.4 terminology):
+
+* **Election safety** — at most one leader per (group, term), across the
+  entire history.
+* **Log matching** — if two nodes hold an entry with the same (index,
+  term), their logs are identical up to that index.  Checked on the
+  intersection of live windows (above both compaction floors).
+* **Leader completeness / commit stability** — once an entry is committed
+  at (index, term), no later state of any node commits a different term at
+  that index; the committed frontier never regresses on any node.
+* **Term monotonicity** — per (node, group), currentTerm never decreases.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+from ..core.types import LEADER
+
+
+class InvariantViolation(AssertionError):
+    pass
+
+
+class ClusterChecker:
+    """Audits a sequence of cluster snapshots (as from DeviceCluster)."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        # (group, term) -> node id of the leader observed at that term.
+        self.leaders: Dict[Tuple[int, int], int] = {}
+        # (group, index) -> term committed there (first observation wins;
+        # any later disagreement is a safety violation).
+        self.committed_terms: Dict[Tuple[int, int], int] = {}
+        self.max_commit = None   # [N, G] per-node committed frontier
+        self.max_term = None     # [N, G]
+
+    def check(self, snap: dict) -> None:
+        """snap: dict of numpy arrays from DeviceCluster.snapshot()."""
+        role, term = snap["role"], snap["term"]
+        commit, last = snap["commit"], snap["last"]
+        base, log_term = snap["base"], snap["log_term"]
+        N, G = role.shape
+        L = log_term.shape[-1]
+
+        # Ring-capacity invariant: the live window must fit the ring, or
+        # appends would alias committed slots (the bounded-window partial
+        # accept rule in the kernel's AppendEntries phase enforces this).
+        window = last - base
+        if (window > L).any():
+            n, g = np.argwhere(window > L)[0]
+            raise InvariantViolation(
+                f"log window exceeds ring: node {n} group {g}: "
+                f"({base[n, g]}, {last[n, g]}] > {L} slots")
+
+        # Term monotonicity per node.
+        if self.max_term is not None and (term < self.max_term).any():
+            n, g = np.argwhere(term < self.max_term)[0]
+            raise InvariantViolation(
+                f"term regressed on node {n} group {g}: "
+                f"{self.max_term[n, g]} -> {term[n, g]}")
+        self.max_term = term.copy() if self.max_term is None \
+            else np.maximum(self.max_term, term)
+
+        # Election safety: one leader per (group, term) ever.
+        for n, g in zip(*np.nonzero(role == LEADER)):
+            key = (int(g), int(term[n, g]))
+            prev = self.leaders.setdefault(key, int(n))
+            if prev != int(n):
+                raise InvariantViolation(
+                    f"two leaders for group {g} term {term[n, g]}: "
+                    f"nodes {prev} and {n}")
+
+        # Commit stability: frontier never regresses.
+        if self.max_commit is not None and (commit < self.max_commit).any():
+            n, g = np.argwhere(commit < self.max_commit)[0]
+            raise InvariantViolation(
+                f"commit regressed on node {n} group {g}: "
+                f"{self.max_commit[n, g]} -> {commit[n, g]}")
+        self.max_commit = commit.copy() if self.max_commit is None \
+            else np.maximum(self.max_commit, commit)
+
+        # Committed-entry term stability + cross-node log matching over the
+        # committed live window.
+        for g in range(G):
+            for n in range(N):
+                lo = int(max(base[n, g] + 1, 1))
+                hi = int(min(commit[n, g], last[n, g]))
+                for idx in range(lo, hi + 1):
+                    t = int(log_term[n, g, idx % L])
+                    key = (g, idx)
+                    prev = self.committed_terms.setdefault(key, t)
+                    if prev != t:
+                        raise InvariantViolation(
+                            f"committed entry changed: group {g} index "
+                            f"{idx}: term {prev} vs {t} (node {n})")
+
+    def check_log_matching(self, snap: dict) -> None:
+        """Pairwise log-matching audit (quadratic; call sparsely)."""
+        last, base, log_term = snap["last"], snap["base"], snap["log_term"]
+        N, G = last.shape
+        L = log_term.shape[-1]
+        for g in range(G):
+            for a in range(N):
+                for b in range(a + 1, N):
+                    lo = int(max(base[a, g], base[b, g]) + 1)
+                    hi = int(min(last[a, g], last[b, g]))
+                    match_at = None
+                    for idx in range(hi, lo - 1, -1):
+                        if log_term[a, g, idx % L] == log_term[b, g, idx % L]:
+                            match_at = idx
+                            break
+                    if match_at is None:
+                        continue
+                    for idx in range(lo, match_at):
+                        ta = int(log_term[a, g, idx % L])
+                        tb = int(log_term[b, g, idx % L])
+                        if ta != tb:
+                            raise InvariantViolation(
+                                f"log matching violated: group {g} nodes "
+                                f"{a}/{b} share ({match_at}) but differ at "
+                                f"{idx}: {ta} vs {tb}")

@@ -1,0 +1,500 @@
+"""Scalar oracle: a loop-based re-derivation of one Multi-Raft node tick.
+
+This implements the SAME protocol semantics as
+:func:`rafting_tpu.core.step.node_step`, but as explicit per-group /
+per-peer Python loops following the reference implementation's scalar logic
+(curioloop/rafting: context/member/Follower.java, Candidate.java,
+Leader.java, Leadership.java, context/RaftRoutine.java) and the Raft paper
+rules.  It is deliberately written WITHOUT vector tricks so that it can
+serve as an independent check of the kernel's vectorization: the parity
+test drives both with identical inputs and compares every state lane and
+every outbound message bit-for-bit.
+
+The only shared computation is the PRNG draw for randomized election
+timeouts: the oracle consumes the same `jax.random` stream so that timer
+outcomes are comparable (the reference re-randomizes the election window on
+every read, support/RaftConfig.java:187-190; which lanes *consume* the draw
+is part of the checked semantics).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+import jax
+import numpy as np
+
+from ..core.types import (
+    CANDIDATE, FOLLOWER, LEADER, NIL, PRE_CANDIDATE,
+    EngineConfig, HostInbox, Messages, RaftState,
+)
+
+
+def _np(tree) -> Dict[str, np.ndarray]:
+    """Flatten a flax struct dataclass into {field: numpy array}."""
+    out = {}
+    for name in tree.__dataclass_fields__:
+        v = getattr(tree, name)
+        if hasattr(v, "__dataclass_fields__"):
+            for sub, arr in _np(v).items():
+                out[f"{name}.{sub}"] = arr
+        else:
+            out[name] = np.asarray(v)
+    return out
+
+
+@dataclass
+class _Log:
+    """Scalar view of one group's log ring."""
+    ring: np.ndarray  # [L] terms
+    base: int
+    base_term: int
+    last: int
+
+    def term_at(self, idx: int) -> int:
+        # Mirrors ring_term_at: <= base -> milestone term; > last -> -1.
+        if idx <= self.base:
+            return int(self.base_term)
+        if idx <= self.last:
+            return int(self.ring[idx % len(self.ring)])
+        return -1
+
+
+def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
+                host: HostInbox):
+    """Advance one node by one tick, scalar semantics.
+
+    Returns (state_dict, outbox_dict, info_dict) of numpy arrays with the
+    same keys/shapes as the kernel's pytrees (nested fields dotted,
+    e.g. ``log.term``).
+    """
+    G, P, B, L, S = (cfg.n_groups, cfg.n_peers, cfg.batch, cfg.log_slots,
+                     cfg.max_submit)
+    maj = cfg.majority
+    s = _np(state)
+    ib = _np(inbox)
+    h = _np(host)
+
+    me = int(s["node_id"])
+    now = int(s["now"]) + 1
+
+    # Same PRNG stream as the kernel (shared on purpose; see module doc).
+    rng, k_to = jax.random.split(state.rng)
+    rand_to = np.asarray(jax.random.randint(
+        k_to, (G,), cfg.election_ticks, 2 * cfg.election_ticks,
+        dtype=np.int32))
+
+    active = s["active"].copy()
+    term = s["term"].astype(np.int64).copy()
+    role = s["role"].copy()
+    voted = s["voted_for"].copy()
+    leader_id = s["leader_id"].copy()
+    commit = s["commit"].copy()
+    ring = s["log.term"].copy()
+    base = s["log.base"].copy()
+    base_term = s["log.base_term"].copy()
+    last = s["log.last"].copy()
+    next_idx = s["next_idx"].copy()
+    match_idx = s["match_idx"].copy()
+    awaiting = s["awaiting"].copy()
+    sent_at = s["sent_at"].copy()
+    need_snap = s["need_snap"].copy()
+    votes = s["votes"].copy()
+    prevotes = s["prevotes"].copy()
+    elect_dl = s["elect_deadline"].copy()
+    hb_due = s["hb_due"].copy()
+
+    old_term = term.copy()
+    old_voted = voted.copy()
+    old_last = last.copy()
+
+    # Outbox accumulators, [P, G] dense like the kernel's.
+    def zi(*shape):
+        return np.zeros(shape, np.int32)
+
+    def zb(*shape):
+        return np.zeros(shape, bool)
+
+    out = {
+        "ae_valid": zb(P, G), "ae_term": zi(P, G), "ae_prev_idx": zi(P, G),
+        "ae_prev_term": zi(P, G), "ae_commit": zi(P, G), "ae_n": zi(P, G),
+        "ae_ents": zi(P, G, B),
+        "aer_valid": zb(P, G), "aer_term": zi(P, G),
+        "aer_success": zb(P, G), "aer_match": zi(P, G),
+        "rv_valid": zb(P, G), "rv_term": zi(P, G), "rv_last_idx": zi(P, G),
+        "rv_last_term": zi(P, G), "rv_prevote": zb(P, G),
+        "rvr_valid": zb(P, G), "rvr_term": zi(P, G), "rvr_granted": zb(P, G),
+        "rvr_prevote": zb(P, G), "rvr_echo": zi(P, G),
+        "is_valid": zb(P, G), "is_term": zi(P, G), "is_idx": zi(P, G),
+        "is_last_term": zi(P, G),
+        "isr_valid": zb(P, G), "isr_term": zi(P, G), "isr_success": zb(P, G),
+    }
+    info = {
+        "submit_start": zi(G), "submit_acc": zi(G), "dirty": zb(G),
+        "appended_from": zi(G), "appended_to": zi(G), "log_tail": zi(G),
+        "commit": zi(G), "leader": np.full(G, NIL, np.int32),
+        "snap_req": zb(G), "snap_req_from": zi(G), "snap_req_idx": zi(G),
+        "snap_req_term": zi(G),
+    }
+
+    for g in range(G):
+        log = _Log(ring[g], int(base[g]), int(base_term[g]), int(last[g]))
+        app_from, app_to = 0, 0
+
+        # ---- 1. term sync: adopt the highest real inbound term ------------
+        # (Raft "if RPC term > currentTerm, become follower"; reference
+        # Follower.java:45-47, Leader.java:224-227.  PreVote request terms
+        # are speculative and excluded.)
+        mt = -1
+        for p in range(P):
+            if ib["ae_valid"][p, g]:
+                mt = max(mt, int(ib["ae_term"][p, g]))
+            if ib["aer_valid"][p, g]:
+                mt = max(mt, int(ib["aer_term"][p, g]))
+            if ib["rv_valid"][p, g] and not ib["rv_prevote"][p, g]:
+                mt = max(mt, int(ib["rv_term"][p, g]))
+            if ib["rvr_valid"][p, g]:
+                mt = max(mt, int(ib["rvr_term"][p, g]))
+            if ib["is_valid"][p, g]:
+                mt = max(mt, int(ib["is_term"][p, g]))
+            if ib["isr_valid"][p, g]:
+                mt = max(mt, int(ib["isr_term"][p, g]))
+        if active[g] and mt > term[g]:
+            term[g] = mt
+            role[g] = FOLLOWER
+            voted[g] = NIL
+            leader_id[g] = NIL
+            elect_dl[g] = now + rand_to[g]
+
+        last_term_v = log.term_at(log.last)
+
+        # ---- 2. vote requests ---------------------------------------------
+        # (reference Follower.requestVote:108-127 / preVote:91-105.)
+        def up_to_date(p):
+            lt, li = int(ib["rv_last_term"][p, g]), int(ib["rv_last_idx"][p, g])
+            return lt > last_term_v or (lt == last_term_v and li >= log.last)
+
+        rv_v = [bool(ib["rv_valid"][p, g]) and active[g] and p != me
+                for p in range(P)]
+        elig = [rv_v[p] and not ib["rv_prevote"][p, g]
+                and int(ib["rv_term"][p, g]) == term[g] and up_to_date(p)
+                and (voted[g] == NIL or voted[g] == p)
+                for p in range(P)]
+        first_elig = next((p for p in range(P) if elig[p]), 0)
+        grant_rv = [elig[p] and (voted[g] == p or p == first_elig)
+                    for p in range(P)]
+        if any(grant_rv) and voted[g] == NIL:
+            voted[g] = first_elig
+        if any(grant_rv):
+            elect_dl[g] = now + rand_to[g]
+        lease_open = now >= elect_dl[g] or leader_id[g] == NIL
+        for p in range(P):
+            if rv_v[p]:
+                pv = bool(ib["rv_prevote"][p, g])
+                if pv:
+                    granted = (int(ib["rv_term"][p, g]) > term[g]
+                               and up_to_date(p) and lease_open)
+                else:
+                    granted = grant_rv[p]
+                out["rvr_valid"][p, g] = True
+                out["rvr_granted"][p, g] = granted
+                out["rvr_prevote"][p, g] = pv
+                out["rvr_echo"][p, g] = ib["rv_term"][p, g]
+                out["rvr_term"][p, g] = term[g]
+
+        # ---- 3. vote responses + tallies ----------------------------------
+        # (reference Candidate.startElection:112-134, prepareElection
+        # tally Follower.java:241-275.)
+        for p in range(P):
+            if not (ib["rvr_valid"][p, g] and active[g]):
+                continue
+            if (ib["rvr_prevote"][p, g] and ib["rvr_granted"][p, g]
+                    and role[g] == PRE_CANDIDATE
+                    and int(ib["rvr_echo"][p, g]) == term[g] + 1):
+                prevotes[g, p] = True
+            if (not ib["rvr_prevote"][p, g] and ib["rvr_granted"][p, g]
+                    and role[g] == CANDIDATE
+                    and int(ib["rvr_term"][p, g]) == term[g]):
+                votes[g, p] = True
+        become_cand_pv = (role[g] == PRE_CANDIDATE
+                          and prevotes[g].sum() >= maj)
+        if become_cand_pv:
+            term[g] += 1
+            role[g] = CANDIDATE
+            voted[g] = me
+            leader_id[g] = NIL
+            votes[g] = False
+            votes[g, me] = True
+            elect_dl[g] = now + rand_to[g]
+        if role[g] == CANDIDATE and votes[g].sum() >= maj:
+            role[g] = LEADER
+            leader_id[g] = me
+            next_idx[g] = log.last + 1
+            match_idx[g] = 0
+            awaiting[g] = False
+            need_snap[g] = False
+            hb_due[g] = now
+
+        # ---- 4. AppendEntries requests ------------------------------------
+        # (reference Follower.appendEntries:35-88.)
+        ae_ok = [bool(ib["ae_valid"][p, g]) and active[g] and p != me
+                 and int(ib["ae_term"][p, g]) == term[g] for p in range(P)]
+        ae_peer = next((p for p in range(P) if ae_ok[p]), 0)
+        ae_any = any(ae_ok) and role[g] != LEADER
+        acc = False
+        tail = 0
+        if ae_any:
+            role[g] = FOLLOWER
+            leader_id[g] = ae_peer
+            elect_dl[g] = now + rand_to[g]
+            prev_i = int(ib["ae_prev_idx"][ae_peer, g])
+            prev_t = int(ib["ae_prev_term"][ae_peer, g])
+            n_e = int(ib["ae_n"][ae_peer, g])
+            # Bounded-window partial accept (see kernel phase 4): never let
+            # the live window (base, last] exceed the ring capacity.
+            n_e = max(0, min(n_e, log.base + L - prev_i))
+            lc = int(ib["ae_commit"][ae_peer, g])
+            ents = ib["ae_ents"][ae_peer, g]
+            acc = (prev_i <= log.base
+                   or (prev_i <= log.last and log.term_at(prev_i) == prev_t))
+            if acc:
+                tail = prev_i + n_e
+                conflict = False
+                for k in range(n_e):
+                    idx = prev_i + 1 + k
+                    if log.base < idx <= log.last \
+                            and log.term_at(idx) != int(ents[k]):
+                        conflict = True
+                        break
+                for k in range(n_e):
+                    idx = prev_i + 1 + k
+                    if idx > log.base:
+                        log.ring[idx % L] = ents[k]
+                new_last = tail if conflict else max(log.last, tail)
+                wrote = n_e > 0 and (new_last != log.last or conflict)
+                if wrote:
+                    app_from, app_to = prev_i + 1, new_last
+                log.last = new_last
+                commit[g] = max(commit[g], min(lc, tail))
+        for p in range(P):
+            if bool(ib["ae_valid"][p, g]) and active[g] and p != me:
+                out["aer_valid"][p, g] = True
+                out["aer_term"][p, g] = term[g]
+                sel = ae_ok[p] and p == ae_peer
+                out["aer_success"][p, g] = sel and acc
+                out["aer_match"][p, g] = (
+                    tail if (sel and acc)
+                    else min(log.last, int(ib["ae_prev_idx"][p, g]) - 1))
+
+        # ---- 5. InstallSnapshot -------------------------------------------
+        # (reference Follower.installSnapshot:130-153 + host completion,
+        # RaftRoutine.restoreCheckpoint:482-541.)
+        is_ok = [bool(ib["is_valid"][p, g]) and active[g] and p != me
+                 and int(ib["is_term"][p, g]) == term[g] for p in range(P)]
+        is_peer = next((p for p in range(P) if is_ok[p]), 0)
+        is_any = any(is_ok) and role[g] != LEADER
+        # Coverage is evaluated against the selected offer whenever one
+        # passed the term check (the reply is sent even if we are — by an
+        # impossible schedule — a same-term leader; matches the kernel).
+        off_idx = int(ib["is_idx"][is_peer, g])
+        off_term = int(ib["is_last_term"][is_peer, g])
+        covered = (any(is_ok)
+                   and (off_idx <= log.base
+                        or (off_idx <= log.last
+                            and log.term_at(off_idx) == off_term)))
+        if is_any:
+            role[g] = FOLLOWER
+            leader_id[g] = is_peer
+            elect_dl[g] = now + rand_to[g]
+            if not covered:
+                info["snap_req"][g] = True
+                info["snap_req_from"][g] = is_peer
+                info["snap_req_idx"][g] = off_idx
+                info["snap_req_term"][g] = off_term
+        for p in range(P):
+            if bool(ib["is_valid"][p, g]) and active[g] and p != me:
+                out["isr_valid"][p, g] = True
+                out["isr_term"][p, g] = term[g]
+                out["isr_success"][p, g] = (is_ok[p] and p == is_peer
+                                            and covered)
+
+        if (h["snap_done"][g] and active[g]
+                and int(h["snap_idx"][g]) > log.base):
+            si, st = int(h["snap_idx"][g]), int(h["snap_term"][g])
+            tail_matches = si <= log.last and log.term_at(si) == st
+            log.base, log.base_term = si, st
+            if not tail_matches:
+                log.last = si
+            commit[g] = max(commit[g], si)
+
+        ct = min(int(h["compact_to"][g]), int(commit[g]))
+        if active[g] and ct > log.base:
+            log.base_term = log.term_at(ct)
+            log.base = ct
+
+        # ---- 6. AppendEntries / snapshot responses (leader side) ----------
+        # (reference Leader.java:224-243, Leadership.updateIndex:75-114.)
+        for p in range(P):
+            r = (bool(ib["aer_valid"][p, g]) and active[g]
+                 and role[g] == LEADER and int(ib["aer_term"][p, g]) == term[g])
+            if r:
+                m = int(ib["aer_match"][p, g])
+                if ib["aer_success"][p, g]:
+                    match_idx[g, p] = max(match_idx[g, p], m)
+                    next_idx[g, p] = max(next_idx[g, p], match_idx[g, p] + 1)
+                    need_snap[g, p] = False
+                else:
+                    next_idx[g, p] = min(max(m + 1, 1), next_idx[g, p])
+                    need_snap[g, p] = next_idx[g, p] <= log.base
+                awaiting[g, p] = False
+            # Unconditional floor (kernel applies it to every lane).
+            next_idx[g, p] = max(next_idx[g, p], log.base + 1)
+            ir = (bool(ib["isr_valid"][p, g]) and active[g]
+                  and role[g] == LEADER and int(ib["isr_term"][p, g]) == term[g])
+            if ir:
+                if ib["isr_success"][p, g]:
+                    need_snap[g, p] = False
+                    next_idx[g, p] = max(next_idx[g, p], log.base + 1)
+                    match_idx[g, p] = max(match_idx[g, p], log.base)
+                awaiting[g, p] = False
+
+        # ---- 7. timers -----------------------------------------------------
+        # (reference Follower.onTimeout:156-168, Candidate.onTimeout:82-88.)
+        start_pre = False
+        timer_cand = False
+        if active[g] and now >= elect_dl[g] and role[g] != LEADER:
+            if cfg.pre_vote:
+                if role[g] in (FOLLOWER, PRE_CANDIDATE):
+                    start_pre = True
+                elif role[g] == CANDIDATE:
+                    timer_cand = True
+            else:
+                timer_cand = True
+        if timer_cand:
+            term[g] += 1
+            voted[g] = me
+            role[g] = CANDIDATE
+            leader_id[g] = NIL
+            votes[g] = False
+            votes[g, me] = True
+            elect_dl[g] = now + rand_to[g]
+        elif start_pre:
+            role[g] = PRE_CANDIDATE
+            leader_id[g] = NIL
+            prevotes[g] = False
+            prevotes[g, me] = True
+            elect_dl[g] = now + rand_to[g]
+        became_cand = become_cand_pv or timer_cand
+        last_term_v = log.term_at(log.last)
+
+        # ---- 8. client submissions ----------------------------------------
+        # (reference RaftStub.submit -> Leader.acceptCommand:128-140.)
+        info["submit_start"][g] = log.last + 1
+        n_acc = 0
+        if active[g] and role[g] == LEADER:
+            free = L - (log.last - log.base)
+            n_acc = max(0, min(int(h["submit_n"][g]), min(free, S)))
+        if n_acc > 0:
+            if app_from == 0:
+                app_from = log.last + 1
+            for k in range(n_acc):
+                log.ring[(log.last + 1 + k) % L] = term[g]
+            log.last += n_acc
+            app_to = log.last
+        info["submit_acc"][g] = n_acc
+
+        # ---- 9. replication fan-out ---------------------------------------
+        # (reference Leader.replicateLog:142-245 + prepareElection fan-out.)
+        heartbeat = role[g] == LEADER and now >= hb_due[g]
+        if active[g] and role[g] == LEADER:
+            for p in range(P):
+                if p == me:
+                    continue
+                has_data = log.last >= next_idx[g, p] and not need_snap[g, p]
+                resend_ok = (not awaiting[g, p]
+                             or now - sent_at[g, p] >= cfg.rpc_timeout_ticks)
+                send_ae = (not need_snap[g, p] and resend_ok
+                           and (has_data or heartbeat))
+                send_is = need_snap[g, p] and resend_ok
+                if send_ae:
+                    n_send = (min(B, log.last - next_idx[g, p] + 1)
+                              if has_data else 0)
+                    prev = int(next_idx[g, p]) - 1
+                    out["ae_valid"][p, g] = True
+                    out["ae_term"][p, g] = term[g]
+                    out["ae_prev_idx"][p, g] = prev
+                    # prev term via batch semantics (<= base -> base_term).
+                    out["ae_prev_term"][p, g] = (
+                        log.base_term if prev <= log.base
+                        else (log.ring[prev % L] if prev <= log.last else -1))
+                    out["ae_commit"][p, g] = commit[g]
+                    out["ae_n"][p, g] = n_send
+                    for k in range(B):
+                        idx = int(next_idx[g, p]) + k
+                        out["ae_ents"][p, g, k] = (
+                            log.base_term if idx <= log.base
+                            else (log.ring[idx % L] if idx <= log.last
+                                  else -1))
+                    if has_data:
+                        awaiting[g, p] = True
+                elif send_is:
+                    out["is_valid"][p, g] = True
+                    out["is_term"][p, g] = term[g]
+                    out["is_idx"][p, g] = log.base
+                    out["is_last_term"][p, g] = log.base_term
+                    awaiting[g, p] = True
+                if send_ae or send_is:
+                    sent_at[g, p] = now
+        if heartbeat:
+            hb_due[g] = now + cfg.heartbeat_ticks
+        if active[g] and (became_cand or start_pre):
+            for p in range(P):
+                if p == me:
+                    continue
+                out["rv_valid"][p, g] = True
+                out["rv_term"][p, g] = term[g] + 1 if start_pre else term[g]
+                out["rv_last_idx"][p, g] = log.last
+                out["rv_last_term"][p, g] = last_term_v
+                out["rv_prevote"][p, g] = start_pre
+
+        # ---- 10. commit advance -------------------------------------------
+        # (reference Leadership.majorIndices:116-130 + the own-term rule,
+        # Leader.tryCommit:256-261.)
+        full = match_idx[g].copy()
+        full[me] = log.last
+        quorum_idx = int(np.sort(full)[P - maj])
+        if (active[g] and role[g] == LEADER and quorum_idx > commit[g]
+                and log.term_at(quorum_idx) == term[g]):
+            commit[g] = quorum_idx
+        match_idx[g] = full
+
+        ring[g] = log.ring
+        base[g], base_term[g], last[g] = log.base, log.base_term, log.last
+        info["dirty"][g] = (term[g] != old_term[g] or voted[g] != old_voted[g]
+                            or last[g] != old_last[g] or app_to > 0)
+        info["appended_from"][g] = app_from
+        info["appended_to"][g] = app_to
+        info["log_tail"][g] = log.last
+        info["commit"][g] = commit[g]
+        info["leader"][g] = leader_id[g]
+
+    new_state = {
+        "node_id": np.asarray(me, np.int32),
+        "now": np.asarray(now, np.int32),
+        "rng": np.asarray(rng),
+        "active": active,
+        "term": term.astype(np.int32),
+        "role": role,
+        "voted_for": voted,
+        "leader_id": leader_id,
+        "commit": commit,
+        "applied": s["applied"],
+        "log.term": ring, "log.base": base, "log.base_term": base_term,
+        "log.last": last,
+        "next_idx": next_idx, "match_idx": match_idx,
+        "awaiting": awaiting, "sent_at": sent_at, "need_snap": need_snap,
+        "votes": votes, "prevotes": prevotes,
+        "elect_deadline": elect_dl, "hb_due": hb_due,
+    }
+    return new_state, out, info

@@ -1,0 +1,128 @@
+"""Chaos fuzzing of the on-device cluster against Raft safety invariants.
+
+Mirrors BASELINE.md evaluation configs 2-5 at test scale: multi-group
+clusters under leader churn, partitions, message loss and snapshot
+catch-up, audited every few ticks by the ClusterChecker (election safety,
+log matching, commit stability, term monotonicity — the reference's
+AssertionError oracles lifted out of the hot path, SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+from rafting_tpu import DeviceCluster, EngineConfig
+from rafting_tpu.testkit import ClusterChecker
+
+
+def chaos_run(cfg, seed, n_ticks, checker_every=2, partition_p=0.08,
+              heal_p=0.25, submit=2):
+    rng = np.random.default_rng(seed)
+    c = DeviceCluster(cfg, seed=seed)
+    chk = ClusterChecker(cfg)
+    partitioned = False
+    for t in range(n_ticks):
+        if not partitioned and rng.random() < partition_p:
+            n = cfg.n_peers
+            k = int(rng.integers(1, n))
+            side = list(rng.permutation(n)[:k])
+            rest = [x for x in range(n) if x not in side]
+            c.set_partition([side, rest])
+            partitioned = True
+        elif partitioned and rng.random() < heal_p:
+            c.heal()
+            partitioned = False
+        c.tick(submit_n=submit)
+        if t % checker_every == 0:
+            chk.check(c.snapshot())
+    c.heal()
+    for _ in range(4 * cfg.election_ticks):
+        c.tick(submit_n=submit)
+    snap = c.snapshot()
+    chk.check(snap)
+    chk.check_log_matching(snap)
+    return c, chk, snap
+
+
+def test_chaos_small_partitions():
+    """Config-2 analog: AppendEntries-heavy small cluster under churn."""
+    cfg = EngineConfig(n_groups=16, n_peers=3, log_slots=32, batch=4,
+                       max_submit=4, election_ticks=6, heartbeat_ticks=2,
+                       rpc_timeout_ticks=5, pre_vote=True)
+    c, chk, snap = chaos_run(cfg, seed=3, n_ticks=160)
+    # After healing, every group must converge to one leader and commit.
+    assert ((snap["role"] == 3).sum(axis=0) == 1).all()
+    assert (snap["commit"].max(axis=0) > 0).all()
+
+
+def test_chaos_five_peers_prevote_churn():
+    """Config-3/4 analog: 5-peer cluster, PreVote on, heavy churn."""
+    cfg = EngineConfig(n_groups=8, n_peers=5, log_slots=32, batch=4,
+                       max_submit=2, election_ticks=8, heartbeat_ticks=2,
+                       rpc_timeout_ticks=6, pre_vote=True)
+    c, chk, snap = chaos_run(cfg, seed=5, n_ticks=200, partition_p=0.12)
+    assert ((snap["role"] == 3).sum(axis=0) == 1).all()
+    assert (snap["commit"].max(axis=0) > 0).all()
+
+
+def test_chaos_snapshot_catchup():
+    """Config-5 analog: isolate a node long enough that the others compact
+    past its log, then heal — it must catch up via InstallSnapshot."""
+    cfg = EngineConfig(n_groups=8, n_peers=3, log_slots=16, batch=4,
+                       max_submit=4, election_ticks=6, heartbeat_ticks=2,
+                       rpc_timeout_ticks=5, pre_vote=True)
+    c = DeviceCluster(cfg, seed=9)
+    chk = ClusterChecker(cfg)
+    # Let leaders emerge and start committing.
+    for _ in range(30):
+        c.tick(submit_n=4)
+    chk.check(c.snapshot())
+    lagger = 2
+    c.isolate(lagger)
+    # Drive enough load that the live side compacts beyond the lagger's
+    # log tail (slack compaction keeps L/4 = 4 entries).
+    for _ in range(80):
+        c.tick(submit_n=4)
+    snap = c.snapshot()
+    live = [n for n in range(3) if n != lagger]
+    assert max(snap["base"][n].max() for n in live) > \
+        snap["last"][lagger].max(), "live side must compact past the lagger"
+    c.heal()
+    for _ in range(60):
+        c.tick(submit_n=2)
+    # Quiesce: stop offering load so the frontier freezes, then let the
+    # lagger drain the replication pipeline.
+    for _ in range(20):
+        c.tick(submit_n=0)
+    snap = c.snapshot()
+    chk.check(snap)
+    chk.check_log_matching(snap)
+    # The lagger caught up: its commit matches the cluster frontier.
+    frontier = snap["commit"].max(axis=0)
+    np.testing.assert_array_equal(snap["commit"][lagger], frontier)
+    assert (snap["base"][lagger] > 0).any(), \
+        "lagger should have installed at least one snapshot"
+
+
+@pytest.mark.parametrize("seed", [21, 22])
+def test_chaos_message_level_drops(seed):
+    """Fine-grained link flaps every tick (not just partitions)."""
+    cfg = EngineConfig(n_groups=8, n_peers=3, log_slots=16, batch=4,
+                       max_submit=2, election_ticks=6, heartbeat_ticks=2,
+                       rpc_timeout_ticks=5, pre_vote=True)
+    rng = np.random.default_rng(seed)
+    c = DeviceCluster(cfg, seed=seed)
+    chk = ClusterChecker(cfg)
+    for t in range(150):
+        conn = rng.random((3, 3)) > 0.2
+        np.fill_diagonal(conn, True)
+        c.conn = np.asarray(conn)
+        c.tick(submit_n=2)
+        if t % 3 == 0:
+            chk.check(c.snapshot())
+    c.heal()
+    for _ in range(30):
+        c.tick(submit_n=2)
+    snap = c.snapshot()
+    chk.check(snap)
+    chk.check_log_matching(snap)
+    assert (snap["commit"].max(axis=0) > 0).all()

@@ -1,0 +1,177 @@
+"""Kernel ↔ scalar-oracle parity under randomized chaos schedules.
+
+Every tick, each node's (state, inbox, host inbox) is fed both to the
+vectorized kernel (`node_step`) and to the loop-based scalar oracle
+(`testkit.oracle.oracle_step`); the resulting state, every outbound message
+(masked by its validity lane) and the step info must agree exactly.  The
+kernel's outputs carry the simulation forward, so each tick is an
+independent check and divergence cannot compound silently.
+
+This is the election-safety/semantics parity requirement from BASELINE.md
+("election-safety parity vs CPU event-loop path") made mechanical — the
+vectorized analog of the reference's manual 3-process kill/restart oracle
+(README.md:28-33).
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from rafting_tpu.core.step import node_step
+from rafting_tpu.core.types import (
+    EngineConfig, HostInbox, Messages, init_state,
+)
+from rafting_tpu.testkit.oracle import _np, oracle_step
+
+# (validity lane, dependent fields) per RPC kind: fields are only
+# meaningful where the lane is set; the kernel leaves arbitrary broadcast
+# values elsewhere.
+MSG_GROUPS = {
+    "ae_valid": ["ae_term", "ae_prev_idx", "ae_prev_term", "ae_commit",
+                 "ae_n", "ae_ents"],
+    "aer_valid": ["aer_term", "aer_success", "aer_match"],
+    "rv_valid": ["rv_term", "rv_last_idx", "rv_last_term", "rv_prevote"],
+    "rvr_valid": ["rvr_term", "rvr_granted", "rvr_prevote", "rvr_echo"],
+    "is_valid": ["is_term", "is_idx", "is_last_term"],
+    "isr_valid": ["isr_term", "isr_success"],
+}
+
+
+def assert_messages_equal(kernel_out: Messages, oracle_out: dict, tag: str):
+    k = _np(kernel_out)
+    for vfield, deps in MSG_GROUPS.items():
+        kv, ov = k[vfield], oracle_out[vfield]
+        np.testing.assert_array_equal(
+            kv, ov, err_msg=f"{tag}: {vfield} mismatch")
+        mask = kv
+        for f in deps:
+            a, b = k[f], oracle_out[f]
+            m = mask[..., None] if a.ndim == 3 else mask
+            np.testing.assert_array_equal(
+                np.where(m, a, 0), np.where(m, b, 0),
+                err_msg=f"{tag}: {f} mismatch (masked by {vfield})")
+
+
+def assert_state_equal(kernel_state, oracle_state: dict, tag: str):
+    k = _np(kernel_state)
+    for f, ov in oracle_state.items():
+        np.testing.assert_array_equal(
+            k[f], ov, err_msg=f"{tag}: state.{f} mismatch")
+
+
+def assert_info_equal(kernel_info, oracle_info: dict, tag: str):
+    k = _np(kernel_info)
+    for f, ov in oracle_info.items():
+        np.testing.assert_array_equal(
+            k[f], ov, err_msg=f"{tag}: info.{f} mismatch")
+
+
+def route_numpy(outboxes, conn):
+    """inbox[dst].field[src] = outbox[src].field[dst], masked by conn."""
+    fields = [f.name for f in dataclasses.fields(Messages)]
+    raw = {f: np.stack([np.asarray(getattr(ob, f)) for ob in outboxes])
+           for f in fields}  # [N(src), P(dst), G, ...]
+    inboxes = []
+    N = len(outboxes)
+    for d in range(N):
+        kw = {}
+        for f in fields:
+            arr = raw[f][:, d].copy()  # [N(src), G, ...]
+            if f.endswith("_valid"):
+                m = conn[:, d]
+                arr = arr & m[:, None]
+            kw[f] = arr
+        inboxes.append(Messages(**{f: np.asarray(v) for f, v in kw.items()}))
+    return inboxes
+
+
+def run_parity(seed: int, n_ticks: int, cfg: EngineConfig,
+               drop_p: float = 0.15, part_p: float = 0.1):
+    N, G = cfg.n_peers, cfg.n_groups
+    rng = np.random.default_rng(seed)
+    states = [init_state(cfg, i, seed=seed) for i in range(N)]
+    outboxes = [Messages.empty(cfg) for _ in range(N)]
+    infos = [None] * N
+    partition_left = 0
+    partition = None
+
+    for t in range(n_ticks):
+        # --- chaos schedule: random drops plus occasional partitions -----
+        if partition_left == 0 and rng.random() < part_p:
+            k = rng.integers(1, N)
+            side = rng.permutation(N)[:k]
+            partition = np.zeros((N, N), bool)
+            for a in range(N):
+                for b in range(N):
+                    partition[a, b] = (a in side) == (b in side)
+            partition_left = int(rng.integers(3, 12))
+        if partition_left > 0:
+            conn = partition.copy()
+            partition_left -= 1
+        else:
+            conn = np.ones((N, N), bool)
+        conn &= rng.random((N, N)) > drop_p
+        np.fill_diagonal(conn, True)
+
+        inboxes = route_numpy(outboxes, conn)
+        new_outboxes = []
+        for n in range(N):
+            sub = rng.integers(0, cfg.max_submit + 1, size=G).astype(np.int32)
+            host = HostInbox.empty(cfg)
+            if infos[n] is not None:
+                prev = infos[n]
+                compact = np.where(
+                    rng.random(G) < 0.3,
+                    np.maximum(np.asarray(states[n].commit)
+                               - cfg.log_slots // 4, 0),
+                    0).astype(np.int32)
+                host = host.replace(
+                    submit_n=sub,
+                    snap_done=np.asarray(prev.snap_req),
+                    snap_idx=np.asarray(prev.snap_req_idx),
+                    snap_term=np.asarray(prev.snap_req_term),
+                    compact_to=compact)
+            else:
+                host = host.replace(submit_n=sub)
+
+            # Oracle FIRST: node_step donates the state buffers.
+            o_state, o_out, o_info = oracle_step(cfg, states[n], inboxes[n],
+                                                 host)
+            k_state, k_out, k_info = node_step(cfg, states[n], inboxes[n],
+                                               host)
+            tag = f"seed={seed} tick={t} node={n}"
+            assert_state_equal(k_state, o_state, tag)
+            assert_messages_equal(k_out, o_out, tag)
+            assert_info_equal(k_info, o_info, tag)
+            states[n] = k_state
+            new_outboxes.append(k_out)
+            infos[n] = k_info
+        outboxes = new_outboxes
+
+    # The schedule must have actually elected leaders / committed entries.
+    total_commit = sum(int(np.asarray(s.commit).sum()) for s in states)
+    assert total_commit > 0, "chaos schedule never committed anything"
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_parity_prevote(seed):
+    cfg = EngineConfig(n_groups=8, n_peers=3, log_slots=16, batch=4,
+                       max_submit=4, election_ticks=6, heartbeat_ticks=2,
+                       rpc_timeout_ticks=5, pre_vote=True)
+    run_parity(seed, n_ticks=60, cfg=cfg)
+
+
+def test_parity_no_prevote():
+    cfg = EngineConfig(n_groups=8, n_peers=3, log_slots=16, batch=4,
+                       max_submit=4, election_ticks=6, heartbeat_ticks=2,
+                       rpc_timeout_ticks=5, pre_vote=False)
+    run_parity(7, n_ticks=60, cfg=cfg)
+
+
+def test_parity_five_nodes():
+    cfg = EngineConfig(n_groups=4, n_peers=5, log_slots=16, batch=2,
+                       max_submit=2, election_ticks=8, heartbeat_ticks=2,
+                       rpc_timeout_ticks=6, pre_vote=True)
+    run_parity(11, n_ticks=50, cfg=cfg, drop_p=0.25, part_p=0.15)
