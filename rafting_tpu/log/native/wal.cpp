@@ -1,0 +1,510 @@
+// Native WAL storage engine for the TPU Multi-Raft node.
+//
+// Role: the durability tier under the device-resident log rings — the
+// TPU-native replacement for the reference's only native component, the
+// embedded RocksDB log store (curioloop/rafting pom.xml:17-21,
+// command/storage/RocksLog.java).  Where the reference opens one RocksDB
+// per Raft group and fsyncs its WAL per group (RocksLog.java:55-89), this
+// engine journals ALL groups of a node into one segmented append-only log
+// and amortizes a single fsync over every group's writes in a tick — the
+// group-commit discipline the vectorized engine's batch step makes natural.
+//
+// Record types (all integers little-endian; every record CRC-framed):
+//   ENTRY     (group, index, term, payload)  — replicated-log entry
+//   STABLE    (group, term, ballot)          — durable (currentTerm, votedFor),
+//                                              the reference's StableLock record
+//                                              (support/StableLock.java:69-80)
+//   TRUNCATE  (group, from)                  — suffix truncation marker
+//   MILESTONE (group, index, term)           — snapshot milestone / log floor
+//                                              (StableLock.java:82-91 + RocksLog
+//                                               epoch column, RocksLog.java:228-242)
+//
+// Recovery replays segments in order, dropping the tail after the first
+// CRC/length mismatch (torn write).  Checkpointing rewrites live state into
+// a fresh segment and deletes older ones (the deleteRange analog).
+//
+// Exposed as a C ABI consumed from Python via ctypes (no pybind11 in the
+// toolchain).  Single-threaded by contract: one node runtime thread owns a
+// handle.
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include <dirent.h>
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x52574131;  // "RWA1"
+constexpr uint8_t kEntry = 1;
+constexpr uint8_t kStable = 2;
+constexpr uint8_t kTruncate = 3;
+constexpr uint8_t kMilestone = 4;
+
+// CRC-32 (IEEE), small table-driven implementation.
+uint32_t crc_table[256];
+bool crc_init_done = false;
+void crc_init() {
+  if (crc_init_done) return;
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc_table[i] = c;
+  }
+  crc_init_done = true;
+}
+uint32_t crc32(const uint8_t* p, size_t n, uint32_t crc = 0) {
+  crc_init();
+  crc = ~crc;
+  for (size_t i = 0; i < n; i++) crc = crc_table[(crc ^ p[i]) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+struct EntryRef {
+  int64_t term;
+  uint32_t seg;     // segment id holding the payload
+  uint64_t off;     // offset of payload bytes within the segment
+  uint32_t len;     // payload length
+};
+
+struct GroupState {
+  int64_t tail = 0;          // last live index (0 = empty)
+  int64_t floor = 0;         // compaction floor ("epoch")
+  int64_t floor_term = 0;    // term at the floor (snapshot milestone term)
+  int64_t stable_term = 0;   // durable currentTerm
+  int64_t ballot = -1;       // durable votedFor (-1 = none)
+  bool has_stable = false;
+  std::map<uint64_t, EntryRef> entries;  // live index -> payload ref
+
+  void drop_suffix(uint64_t from) {
+    entries.erase(entries.lower_bound(from), entries.end());
+    if (tail >= (int64_t)from) tail = (int64_t)from - 1;
+  }
+  void drop_prefix(uint64_t upto) {  // drop indices <= upto
+    entries.erase(entries.begin(), entries.upper_bound(upto));
+  }
+};
+
+struct Wal {
+  std::string dir;
+  uint64_t segment_bytes;
+  std::unordered_map<uint32_t, GroupState> groups;
+  // open segment
+  int fd = -1;
+  uint32_t seg_id = 0;
+  uint64_t seg_off = 0;
+  std::vector<uint8_t> buf;        // pending (unflushed) records
+  std::vector<uint32_t> live_segs; // existing segment ids, ascending
+  std::string err;
+};
+
+std::string seg_path(const Wal& w, uint32_t id) {
+  char name[32];
+  std::snprintf(name, sizeof name, "%08u.wal", id);
+  return w.dir + "/" + name;
+}
+
+void put_u32(std::vector<uint8_t>& b, uint32_t v) {
+  b.push_back(v & 0xFF); b.push_back((v >> 8) & 0xFF);
+  b.push_back((v >> 16) & 0xFF); b.push_back((v >> 24) & 0xFF);
+}
+void put_u64(std::vector<uint8_t>& b, uint64_t v) {
+  for (int i = 0; i < 8; i++) b.push_back((v >> (8 * i)) & 0xFF);
+}
+uint32_t get_u32(const uint8_t* p) {
+  return (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+         ((uint32_t)p[3] << 24);
+}
+uint64_t get_u64(const uint8_t* p) {
+  uint64_t v = 0;
+  for (int i = 7; i >= 0; i--) v = (v << 8) | p[i];
+  return v;
+}
+
+// Record frame: u32 magic | u32 body_len | u32 body_crc | body.
+// Body: u8 type | type-specific fields.
+void frame(std::vector<uint8_t>& out, const std::vector<uint8_t>& body) {
+  put_u32(out, kMagic);
+  put_u32(out, (uint32_t)body.size());
+  put_u32(out, crc32(body.data(), body.size()));
+  out.insert(out.end(), body.begin(), body.end());
+}
+
+bool open_segment(Wal& w, uint32_t id, bool fresh) {
+  if (w.fd >= 0) { ::close(w.fd); w.fd = -1; }
+  std::string p = seg_path(w, id);
+  int flags = O_CREAT | O_WRONLY | (fresh ? O_TRUNC : O_APPEND);
+  int fd = ::open(p.c_str(), flags, 0644);
+  if (fd < 0) { w.err = "open " + p + ": " + std::strerror(errno); return false; }
+  w.fd = fd;
+  w.seg_id = id;
+  struct stat st;
+  w.seg_off = (!fresh && ::fstat(fd, &st) == 0) ? (uint64_t)st.st_size : 0;
+  if (fresh || std::find(w.live_segs.begin(), w.live_segs.end(), id) ==
+                   w.live_segs.end())
+    w.live_segs.push_back(id);
+  return true;
+}
+
+// Apply one record body to the in-memory index.  `seg`/`payload_off` locate
+// ENTRY payload bytes for later pread.
+bool apply_body(Wal& w, const uint8_t* b, uint32_t len, uint32_t seg,
+                uint64_t payload_off_base) {
+  if (len < 1) return false;
+  uint8_t type = b[0];
+  switch (type) {
+    case kEntry: {
+      if (len < 1 + 4 + 8 + 8 + 4) return false;
+      uint32_t g = get_u32(b + 1);
+      uint64_t idx = get_u64(b + 5);
+      int64_t term = (int64_t)get_u64(b + 13);
+      uint32_t plen = get_u32(b + 21);
+      if (len != 1 + 4 + 8 + 8 + 4 + plen) return false;
+      auto& gs = w.groups[g];
+      gs.drop_suffix(idx);  // overwrite implies any old suffix at >= idx dies
+      gs.entries[idx] = EntryRef{term, seg, payload_off_base + 25, plen};
+      gs.tail = (int64_t)idx;
+      return true;
+    }
+    case kStable: {
+      if (len != 1 + 4 + 8 + 8) return false;
+      uint32_t g = get_u32(b + 1);
+      auto& gs = w.groups[g];
+      gs.stable_term = (int64_t)get_u64(b + 5);
+      gs.ballot = (int64_t)get_u64(b + 13);
+      gs.has_stable = true;
+      return true;
+    }
+    case kTruncate: {
+      if (len != 1 + 4 + 8) return false;
+      uint32_t g = get_u32(b + 1);
+      w.groups[g].drop_suffix(get_u64(b + 5));
+      return true;
+    }
+    case kMilestone: {
+      if (len != 1 + 4 + 8 + 8) return false;
+      uint32_t g = get_u32(b + 1);
+      uint64_t idx = get_u64(b + 5);
+      int64_t term = (int64_t)get_u64(b + 13);
+      auto& gs = w.groups[g];
+      if ((int64_t)idx > gs.floor) {
+        gs.floor = (int64_t)idx;
+        gs.floor_term = term;
+        gs.drop_prefix(idx);
+        if (gs.tail < gs.floor) gs.tail = gs.floor;
+      }
+      return true;
+    }
+    default:
+      return false;
+  }
+}
+
+bool replay_segment(Wal& w, uint32_t id) {
+  std::string p = seg_path(w, id);
+  int fd = ::open(p.c_str(), O_RDONLY);
+  if (fd < 0) return false;
+  struct stat st;
+  ::fstat(fd, &st);
+  std::vector<uint8_t> data((size_t)st.st_size);
+  ssize_t rd = data.empty() ? 0 : ::pread(fd, data.data(), data.size(), 0);
+  ::close(fd);
+  if (rd < 0) return false;
+  size_t n = (size_t)rd, off = 0;
+  while (off + 12 <= n) {
+    if (get_u32(&data[off]) != kMagic) break;           // torn tail
+    uint32_t blen = get_u32(&data[off + 4]);
+    uint32_t crc = get_u32(&data[off + 8]);
+    if (off + 12 + blen > n) break;                     // torn tail
+    if (crc32(&data[off + 12], blen) != crc) break;     // corrupt tail
+    apply_body(w, &data[off + 12], blen, id, off + 12);
+    off += 12 + blen;
+  }
+  // If a torn tail was detected, truncate the file to the valid prefix so
+  // future appends don't interleave with garbage.
+  if (off < n) ::truncate(p.c_str(), (off_t)off);
+  return true;
+}
+
+bool flush_buf(Wal& w) {
+  if (w.buf.empty()) return true;
+  size_t off = 0;
+  while (off < w.buf.size()) {
+    ssize_t wr = ::write(w.fd, w.buf.data() + off, w.buf.size() - off);
+    if (wr < 0) { w.err = std::strerror(errno); return false; }
+    off += (size_t)wr;
+  }
+  w.seg_off += w.buf.size();
+  w.buf.clear();
+  return true;
+}
+
+void maybe_rotate(Wal& w) {
+  if (w.seg_off + w.buf.size() < w.segment_bytes) return;
+  flush_buf(w);
+  ::fsync(w.fd);
+  open_segment(w, w.seg_id + 1, true);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* wal_open(const char* dir, uint64_t segment_bytes) {
+  Wal* w = new Wal();
+  w->dir = dir;
+  w->segment_bytes = segment_bytes ? segment_bytes : (64u << 20);
+  ::mkdir(dir, 0755);
+  // Discover and replay segments in ascending id order.
+  std::vector<uint32_t> segs;
+  if (DIR* d = ::opendir(dir)) {
+    while (dirent* e = ::readdir(d)) {
+      unsigned id;
+      if (std::sscanf(e->d_name, "%8u.wal", &id) == 1) segs.push_back(id);
+    }
+    ::closedir(d);
+  }
+  std::sort(segs.begin(), segs.end());
+  for (uint32_t id : segs) {
+    replay_segment(*w, id);
+    w->live_segs.push_back(id);
+  }
+  uint32_t next = segs.empty() ? 0 : segs.back();
+  if (!open_segment(*w, next, segs.empty())) { delete w; return nullptr; }
+  return w;
+}
+
+void wal_close(void* h) {
+  Wal* w = (Wal*)h;
+  if (!w) return;
+  flush_buf(*w);
+  if (w->fd >= 0) { ::fsync(w->fd); ::close(w->fd); }
+  delete w;
+}
+
+// -- writes (buffered; durable after wal_sync) ------------------------------
+
+void wal_append_entry(void* h, uint32_t group, uint64_t index, int64_t term,
+                      const uint8_t* payload, uint32_t plen) {
+  Wal* w = (Wal*)h;
+  std::vector<uint8_t> body;
+  body.reserve(25 + plen);
+  body.push_back(kEntry);
+  put_u32(body, group);
+  put_u64(body, index);
+  put_u64(body, (uint64_t)term);
+  put_u32(body, plen);
+  if (plen) body.insert(body.end(), payload, payload + plen);
+  // Index update mirrors replay so reads see the write immediately; the
+  // payload ref points into the open segment at its post-flush offset.
+  uint64_t body_off = w->seg_off + w->buf.size() + 12;
+  auto& gs = w->groups[group];
+  gs.drop_suffix(index);
+  gs.entries[index] = EntryRef{term, w->seg_id, body_off + 25, plen};
+  gs.tail = (int64_t)index;
+  frame(w->buf, body);
+  maybe_rotate(*w);
+}
+
+void wal_append_stable(void* h, uint32_t group, int64_t term, int64_t ballot) {
+  Wal* w = (Wal*)h;
+  std::vector<uint8_t> body;
+  body.push_back(kStable);
+  put_u32(body, group);
+  put_u64(body, (uint64_t)term);
+  put_u64(body, (uint64_t)ballot);
+  auto& gs = w->groups[group];
+  gs.stable_term = term;
+  gs.ballot = ballot;
+  gs.has_stable = true;
+  frame(w->buf, body);
+  maybe_rotate(*w);
+}
+
+void wal_truncate(void* h, uint32_t group, uint64_t from) {
+  Wal* w = (Wal*)h;
+  std::vector<uint8_t> body;
+  body.push_back(kTruncate);
+  put_u32(body, group);
+  put_u64(body, from);
+  w->groups[group].drop_suffix(from);
+  frame(w->buf, body);
+  maybe_rotate(*w);
+}
+
+void wal_milestone(void* h, uint32_t group, uint64_t index, int64_t term) {
+  Wal* w = (Wal*)h;
+  std::vector<uint8_t> body;
+  body.push_back(kMilestone);
+  put_u32(body, group);
+  put_u64(body, index);
+  put_u64(body, (uint64_t)term);
+  auto& gs = w->groups[group];
+  if ((int64_t)index > gs.floor) {
+    gs.floor = (int64_t)index;
+    gs.floor_term = term;
+    gs.drop_prefix(index);
+    if (gs.tail < gs.floor) gs.tail = gs.floor;
+  }
+  frame(w->buf, body);
+  maybe_rotate(*w);
+}
+
+// Flush buffered records and fsync — the durability barrier.  One call per
+// node tick covers every group (group commit).
+int wal_sync(void* h) {
+  Wal* w = (Wal*)h;
+  if (!flush_buf(*w)) return -1;
+  return ::fsync(w->fd) == 0 ? 0 : -1;
+}
+
+// -- reads ------------------------------------------------------------------
+
+int64_t wal_tail(void* h, uint32_t group) {
+  Wal* w = (Wal*)h;
+  auto it = w->groups.find(group);
+  return it == w->groups.end() ? 0 : it->second.tail;
+}
+int64_t wal_floor(void* h, uint32_t group) {
+  Wal* w = (Wal*)h;
+  auto it = w->groups.find(group);
+  return it == w->groups.end() ? 0 : it->second.floor;
+}
+int64_t wal_floor_term(void* h, uint32_t group) {
+  Wal* w = (Wal*)h;
+  auto it = w->groups.find(group);
+  return it == w->groups.end() ? 0 : it->second.floor_term;
+}
+int wal_stable(void* h, uint32_t group, int64_t* term, int64_t* ballot) {
+  Wal* w = (Wal*)h;
+  auto it = w->groups.find(group);
+  if (it == w->groups.end() || !it->second.has_stable) return 0;
+  *term = it->second.stable_term;
+  *ballot = it->second.ballot;
+  return 1;
+}
+// Entry term at index, or -1 if absent (floor itself reports floor_term).
+int64_t wal_entry_term(void* h, uint32_t group, uint64_t index) {
+  Wal* w = (Wal*)h;
+  auto git = w->groups.find(group);
+  if (git == w->groups.end()) return -1;
+  auto& gs = git->second;
+  if ((int64_t)index == gs.floor) return gs.floor_term;
+  auto it = gs.entries.find(index);
+  return it == gs.entries.end() ? -1 : it->second.term;
+}
+int64_t wal_entry_len(void* h, uint32_t group, uint64_t index) {
+  Wal* w = (Wal*)h;
+  auto git = w->groups.find(group);
+  if (git == w->groups.end()) return -1;
+  auto it = git->second.entries.find(index);
+  return it == git->second.entries.end() ? -1 : (int64_t)it->second.len;
+}
+// Copy payload into caller buffer; returns bytes copied or -1.
+int64_t wal_entry_payload(void* h, uint32_t group, uint64_t index,
+                          uint8_t* out, uint64_t cap) {
+  Wal* w = (Wal*)h;
+  auto git = w->groups.find(group);
+  if (git == w->groups.end()) return -1;
+  auto it = git->second.entries.find(index);
+  if (it == git->second.entries.end()) return -1;
+  const EntryRef& r = it->second;
+  if (r.len > cap) return -1;
+  if (r.len == 0) return 0;
+  if (r.seg == w->seg_id && r.off >= w->seg_off) {
+    // Still in the unflushed buffer.
+    size_t boff = (size_t)(r.off - w->seg_off);
+    if (boff + r.len > w->buf.size()) return -1;
+    std::memcpy(out, w->buf.data() + boff, r.len);
+    return r.len;
+  }
+  std::string p = seg_path(*w, r.seg);
+  int fd = ::open(p.c_str(), O_RDONLY);
+  if (fd < 0) return -1;
+  ssize_t rd = ::pread(fd, out, r.len, (off_t)r.off);
+  ::close(fd);
+  return rd == (ssize_t)r.len ? rd : -1;
+}
+
+uint64_t wal_group_count(void* h) { return ((Wal*)h)->groups.size(); }
+uint64_t wal_segment_count(void* h) { return ((Wal*)h)->live_segs.size(); }
+
+// List group ids into caller buffer; returns count written.
+uint64_t wal_groups(void* h, uint32_t* out, uint64_t cap) {
+  Wal* w = (Wal*)h;
+  uint64_t n = 0;
+  for (auto& kv : w->groups) {
+    if (n >= cap) break;
+    out[n++] = kv.first;
+  }
+  return n;
+}
+
+// Rewrite all live state into a fresh segment and delete older segments —
+// the compaction/GC pass (the reference's RocksDB deleteRange + snapshot
+// retention analog, RocksLog.java:228-242).
+int wal_checkpoint(void* h) {
+  Wal* w = (Wal*)h;
+  if (!flush_buf(*w)) return -1;
+  ::fsync(w->fd);
+  uint32_t new_id = w->seg_id + 1;
+  std::vector<uint32_t> old_segs = w->live_segs;
+  if (!open_segment(*w, new_id, true)) return -1;
+  // Track only segments written from here on (rotation during the rewrite
+  // may add more); everything in old_segs dies afterwards.
+  w->live_segs.assign(1, new_id);
+  // Re-emit live records; payload bytes are read via the OLD refs before
+  // the index is repointed.
+  for (auto& kv : w->groups) {
+    uint32_t g = kv.first;
+    GroupState& gs = kv.second;
+    if (gs.has_stable) wal_append_stable(h, g, gs.stable_term, gs.ballot);
+    if (gs.floor > 0) {
+      std::vector<uint8_t> body;
+      body.push_back(kMilestone);
+      put_u32(body, g);
+      put_u64(body, (uint64_t)gs.floor);
+      put_u64(body, (uint64_t)gs.floor_term);
+      frame(w->buf, body);
+    }
+    // Copy entries (iterate over a snapshot of refs; wal_append_entry
+    // mutates the map).
+    std::vector<std::pair<uint64_t, EntryRef>> ents(gs.entries.begin(),
+                                                    gs.entries.end());
+    for (auto& er : ents) {
+      std::vector<uint8_t> payload(er.second.len);
+      if (er.second.len) {
+        std::string p = seg_path(*w, er.second.seg);
+        int fd = ::open(p.c_str(), O_RDONLY);
+        if (fd < 0) return -1;
+        ssize_t rd = ::pread(fd, payload.data(), er.second.len,
+                             (off_t)er.second.off);
+        ::close(fd);
+        if (rd != (ssize_t)er.second.len) return -1;
+      }
+      wal_append_entry(h, g, er.first, er.second.term, payload.data(),
+                       er.second.len);
+    }
+  }
+  if (!flush_buf(*w)) return -1;
+  if (::fsync(w->fd) != 0) return -1;
+  for (uint32_t id : old_segs)
+    if (std::find(w->live_segs.begin(), w->live_segs.end(), id) ==
+        w->live_segs.end())
+      ::unlink(seg_path(*w, id).c_str());
+  return 0;
+}
+
+const char* wal_error(void* h) { return ((Wal*)h)->err.c_str(); }
+
+}  // extern "C"

@@ -1,0 +1,175 @@
+"""LogStore: the host-side log facade the node runtime drives each tick.
+
+Responsibilities (mapping to the reference's storage contracts):
+
+* durable entry payloads + terms  — RaftLog.newEntry/append
+  (command/RaftLog.java:11-134, command/storage/RocksLog.java:82-196)
+* suffix truncation on conflict   — RaftLog.truncate (RocksLog.java:219-225)
+* compaction floor ("epoch")      — RaftLog.flush (RocksLog.java:228-242)
+* durable (term, ballot)          — StableLock (support/StableLock.java:69-80)
+* milestone (snapshot index/term) — StableLock milestone (82-91)
+* crash recovery → device state   — RaftContext.initialize restore path
+  (context/RaftContext.java:91-113)
+
+The tick protocol (enforced by the node runtime): all writes implied by a
+device step are staged, then ONE :meth:`sync` makes them durable *before*
+any RPC produced by that step leaves the node — the reference's
+persist-before-reply rule (context/member/RaftMember.java:25,
+RocksLog.flushWal after append) amortized over every group at once.
+
+A bounded in-memory payload cache keeps the replication hot path
+(leader batch fetch) off the WAL read path; entries below the compaction
+floor are pruned as the floor advances.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .wal import WalStore
+
+
+class LogStore:
+    def __init__(self, path: str, segment_bytes: int = 64 << 20, *,
+                 force_python: bool = False):
+        self.wal = WalStore(path, segment_bytes, force_python=force_python)
+        # (group, index) -> payload bytes; hot mirror of the live window.
+        self._cache: Dict[tuple, bytes] = {}
+        # last durable (term, ballot) per group, to skip no-op stable writes
+        self._stable: Dict[int, tuple] = {}
+        self._durable_tail: Dict[int, int] = {}
+
+    # -- staging writes (durable after sync()) ------------------------------
+
+    def append_entries(self, g: int, start: int, terms: Sequence[int],
+                       payloads: Sequence[bytes]) -> None:
+        """Write entries [start, start+len) (overwrite semantics)."""
+        for k, (t, p) in enumerate(zip(terms, payloads)):
+            idx = start + k
+            self.wal.append_entry(g, idx, int(t), p)
+            self._cache[(g, idx)] = p
+        self._durable_tail[g] = max(self._durable_tail.get(g, 0),
+                                    start + len(terms) - 1)
+
+    def truncate_to(self, g: int, tail: int) -> None:
+        """Ensure the durable suffix beyond `tail` dies (conflict/snapshot
+        discard).  No-op if the durable tail is already <= tail."""
+        if self._durable_tail.get(g, self.wal.tail(g)) > tail:
+            self.wal.truncate(g, tail + 1)
+            self._durable_tail[g] = tail
+            for key in [k for k in self._cache
+                        if k[0] == g and k[1] > tail]:
+                del self._cache[key]
+
+    def put_stable(self, g: int, term: int, ballot: int) -> None:
+        if self._stable.get(g) == (term, ballot):
+            return
+        self.wal.append_stable(g, term, ballot)
+        self._stable[g] = (term, ballot)
+
+    def set_floor(self, g: int, index: int, term: int) -> None:
+        """Raise the compaction floor (snapshot milestone)."""
+        if index <= self.wal.floor(g):
+            return
+        self.wal.milestone(g, index, term)
+        for key in [k for k in self._cache if k[0] == g and k[1] <= index]:
+            del self._cache[key]
+        self._durable_tail[g] = max(self._durable_tail.get(g, 0), index)
+
+    def sync(self) -> None:
+        """The durability barrier: one fsync covering all staged writes."""
+        self.wal.sync()
+
+    def checkpoint(self) -> None:
+        """Rewrite live state, dropping dead segments (GC)."""
+        self.wal.checkpoint()
+
+    # -- reads ---------------------------------------------------------------
+
+    def payload(self, g: int, idx: int) -> Optional[bytes]:
+        p = self._cache.get((g, idx))
+        if p is not None:
+            return p
+        p = self.wal.entry_payload(g, idx)
+        if p is not None:
+            self._cache[(g, idx)] = p
+        return p
+
+    def payload_batch(self, g: int, start: int, n: int) -> List[bytes]:
+        out = []
+        for i in range(start, start + n):
+            p = self.payload(g, i)
+            out.append(b"" if p is None else p)
+        return out
+
+    def entry_term(self, g: int, idx: int) -> int:
+        return int(self.wal.entry_term(g, idx))
+
+    def stable(self, g: int):
+        return self.wal.stable(g)
+
+    def tail(self, g: int) -> int:
+        return int(self.wal.tail(g))
+
+    def floor(self, g: int) -> int:
+        return int(self.wal.floor(g))
+
+    def floor_term(self, g: int) -> int:
+        return int(self.wal.floor_term(g))
+
+    def close(self) -> None:
+        self.wal.close()
+
+
+def restore_raft_state(cfg, node_id: int, store: LogStore, seed: int = 0):
+    """Rebuild the device RaftState from the durable store after a crash.
+
+    Follows the reference's restore order (RaftContext.initialize,
+    context/RaftContext.java:91-113): stable (term, ballot) first, then the
+    log window above the milestone floor.  commitIndex is NOT persisted —
+    it is rediscovered from leaderCommit traffic, exactly like the
+    reference's volatile commitIndex (RocksLog.java:50, 92-109) — except
+    entries at/below the floor, which are committed by definition.
+    """
+    import jax.numpy as jnp
+
+    from ..core.types import NIL, init_state
+
+    state = init_state(cfg, node_id, seed=seed)
+    G, L = cfg.n_groups, cfg.log_slots
+    term = np.zeros(G, np.int32)
+    voted = np.full(G, NIL, np.int32)
+    base = np.zeros(G, np.int32)
+    base_term = np.zeros(G, np.int32)
+    last = np.zeros(G, np.int32)
+    commit = np.zeros(G, np.int32)
+    ring = np.zeros((G, L), np.int32)
+    for g in range(G):
+        st = store.stable(g)
+        if st is not None:
+            term[g], voted[g] = st
+        floor = store.floor(g)
+        base[g] = floor
+        base_term[g] = store.floor_term(g)
+        tail = store.tail(g)
+        last[g] = max(tail, floor)
+        commit[g] = floor
+        for idx in range(floor + 1, last[g] + 1):
+            t = store.entry_term(g, idx)
+            if t < 0:
+                # Gap above the floor (shouldn't happen with a consistent
+                # WAL): fall back to the contiguous prefix.
+                last[g] = idx - 1
+                break
+            ring[g, idx % L] = t
+    return state.replace(
+        term=jnp.asarray(term), voted_for=jnp.asarray(voted),
+        commit=jnp.asarray(commit),
+        log=state.log.replace(
+            term=jnp.asarray(ring), base=jnp.asarray(base),
+            base_term=jnp.asarray(base_term), last=jnp.asarray(last)),
+        next_idx=jnp.asarray(np.broadcast_to(last[:, None] + 1,
+                                             (G, cfg.n_peers)).copy()),
+    )

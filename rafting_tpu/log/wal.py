@@ -1,0 +1,368 @@
+"""WAL storage engine binding: native C++ backend with a Python fallback.
+
+The native engine lives in ``native/wal.cpp`` (see its header comment for
+the record format and recovery semantics).  It is compiled on first use
+with the system toolchain and loaded through ctypes — the binding style
+this environment supports (no pybind11).  ``PyWal`` reimplements the same
+contract in pure Python for platforms without a compiler; both backends
+read/write the identical on-disk format (cross-checked in
+tests/test_wal.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+import zlib
+from typing import Dict, Optional
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_SRC = os.path.join(_NATIVE_DIR, "wal.cpp")
+_SO = os.path.join(_NATIVE_DIR, "libwal.so")
+_build_lock = threading.Lock()
+_lib = None
+_build_err: Optional[str] = None
+
+
+def _build_native() -> Optional[str]:
+    """Compile the native engine if missing/stale; return error or None."""
+    try:
+        if (os.path.exists(_SO)
+                and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+            return None
+        r = subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO],
+            capture_output=True, text=True, timeout=120)
+        if r.returncode != 0:
+            return r.stderr[-2000:]
+        return None
+    except Exception as e:  # toolchain absent
+        return str(e)
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_err
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        _build_err = _build_native()
+        if _build_err is not None:
+            return None
+        lib = ctypes.CDLL(_SO)
+        lib.wal_open.restype = ctypes.c_void_p
+        lib.wal_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.wal_close.argtypes = [ctypes.c_void_p]
+        lib.wal_append_entry.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64, ctypes.c_int64,
+            ctypes.c_char_p, ctypes.c_uint32]
+        lib.wal_append_stable.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int64, ctypes.c_int64]
+        lib.wal_truncate.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64]
+        lib.wal_milestone.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64, ctypes.c_int64]
+        lib.wal_sync.argtypes = [ctypes.c_void_p]
+        lib.wal_sync.restype = ctypes.c_int
+        for f, res in [("wal_tail", ctypes.c_int64),
+                       ("wal_floor", ctypes.c_int64),
+                       ("wal_floor_term", ctypes.c_int64),
+                       ("wal_entry_term", ctypes.c_int64),
+                       ("wal_entry_len", ctypes.c_int64)]:
+            fn = getattr(lib, f)
+            fn.restype = res
+            fn.argtypes = ([ctypes.c_void_p, ctypes.c_uint32]
+                           + ([ctypes.c_uint64] if "entry" in f else []))
+        lib.wal_stable.restype = ctypes.c_int
+        lib.wal_stable.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+        lib.wal_entry_payload.restype = ctypes.c_int64
+        lib.wal_entry_payload.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint64]
+        lib.wal_checkpoint.argtypes = [ctypes.c_void_p]
+        lib.wal_checkpoint.restype = ctypes.c_int
+        lib.wal_segment_count.argtypes = [ctypes.c_void_p]
+        lib.wal_segment_count.restype = ctypes.c_uint64
+        _lib = lib
+        return lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class _NativeWal:
+    def __init__(self, path: str, segment_bytes: int):
+        self._lib = _load()
+        assert self._lib is not None
+        self._h = self._lib.wal_open(path.encode(), segment_bytes)
+        if not self._h:
+            raise IOError(f"wal_open failed for {path}")
+
+    def close(self):
+        if self._h:
+            self._lib.wal_close(self._h)
+            self._h = None
+
+    def append_entry(self, g, idx, term, payload: bytes):
+        self._lib.wal_append_entry(self._h, g, idx, term, payload,
+                                   len(payload))
+
+    def append_stable(self, g, term, ballot):
+        self._lib.wal_append_stable(self._h, g, term, ballot)
+
+    def truncate(self, g, frm):
+        self._lib.wal_truncate(self._h, g, frm)
+
+    def milestone(self, g, idx, term):
+        self._lib.wal_milestone(self._h, g, idx, term)
+
+    def sync(self):
+        if self._lib.wal_sync(self._h) != 0:
+            raise IOError("wal_sync failed")
+
+    def tail(self, g):
+        return self._lib.wal_tail(self._h, g)
+
+    def floor(self, g):
+        return self._lib.wal_floor(self._h, g)
+
+    def floor_term(self, g):
+        return self._lib.wal_floor_term(self._h, g)
+
+    def stable(self, g):
+        t = ctypes.c_int64()
+        b = ctypes.c_int64()
+        if self._lib.wal_stable(self._h, g, ctypes.byref(t), ctypes.byref(b)):
+            return int(t.value), int(b.value)
+        return None
+
+    def entry_term(self, g, idx):
+        return self._lib.wal_entry_term(self._h, g, idx)
+
+    def entry_payload(self, g, idx) -> Optional[bytes]:
+        n = self._lib.wal_entry_len(self._h, g, idx)
+        if n < 0:
+            return None
+        buf = ctypes.create_string_buffer(n)
+        got = self._lib.wal_entry_payload(self._h, g, idx, buf, n)
+        if got != n:
+            return None
+        return buf.raw[:n]
+
+    def checkpoint(self):
+        if self._lib.wal_checkpoint(self._h) != 0:
+            raise IOError("wal_checkpoint failed")
+
+    def segment_count(self):
+        return int(self._lib.wal_segment_count(self._h))
+
+
+_MAGIC = 0x52574131
+_ENTRY, _STABLE, _TRUNCATE, _MILESTONE = 1, 2, 3, 4
+
+
+class _PyGroup:
+    __slots__ = ("tail", "floor", "floor_term", "stable", "entries")
+
+    def __init__(self):
+        self.tail = 0
+        self.floor = 0
+        self.floor_term = 0
+        self.stable = None  # (term, ballot)
+        self.entries: Dict[int, tuple] = {}  # idx -> (term, payload)
+
+    def drop_suffix(self, frm):
+        for i in [i for i in self.entries if i >= frm]:
+            del self.entries[i]
+        self.tail = min(self.tail, frm - 1)
+
+    def drop_prefix(self, upto):
+        for i in [i for i in self.entries if i <= upto]:
+            del self.entries[i]
+
+
+class PyWal:
+    """Pure-Python engine, byte-compatible with the native one."""
+
+    def __init__(self, path: str, segment_bytes: int = 64 << 20):
+        self.dir = path
+        self.segment_bytes = segment_bytes
+        os.makedirs(path, exist_ok=True)
+        self.groups: Dict[int, _PyGroup] = {}
+        segs = sorted(int(f[:8]) for f in os.listdir(path)
+                      if f.endswith(".wal") and f[:8].isdigit())
+        for sid in segs:
+            self._replay(sid)
+        self._segs = segs or [0]
+        self._sid = self._segs[-1]
+        self._f = open(self._seg_path(self._sid), "ab")
+        self._buf = bytearray()
+
+    def _seg_path(self, sid):
+        return os.path.join(self.dir, f"{sid:08d}.wal")
+
+    def _g(self, g) -> _PyGroup:
+        return self.groups.setdefault(g, _PyGroup())
+
+    def _replay(self, sid):
+        with open(self._seg_path(sid), "rb") as f:
+            data = f.read()
+        off, n = 0, len(data)
+        while off + 12 <= n:
+            magic, blen, crc = struct.unpack_from("<III", data, off)
+            if magic != _MAGIC or off + 12 + blen > n:
+                break
+            body = data[off + 12: off + 12 + blen]
+            if zlib.crc32(body) != crc:
+                break
+            self._apply(body)
+            off += 12 + blen
+        if off < n:
+            with open(self._seg_path(sid), "r+b") as f:
+                f.truncate(off)
+
+    def _apply(self, body: bytes):
+        t = body[0]
+        if t == _ENTRY:
+            g, idx, term, plen = struct.unpack_from("<IQQI", body, 1)
+            gs = self._g(g)
+            gs.drop_suffix(idx)
+            gs.entries[idx] = (_signed(term), bytes(body[25:25 + plen]))
+            gs.tail = idx
+        elif t == _STABLE:
+            g, term, ballot = struct.unpack_from("<IQQ", body, 1)
+            self._g(g).stable = (_signed(term), _signed(ballot))
+        elif t == _TRUNCATE:
+            g, frm = struct.unpack_from("<IQ", body, 1)
+            self._g(g).drop_suffix(frm)
+        elif t == _MILESTONE:
+            g, idx, term = struct.unpack_from("<IQQ", body, 1)
+            gs = self._g(g)
+            if idx > gs.floor:
+                gs.floor, gs.floor_term = idx, _signed(term)
+                gs.drop_prefix(idx)
+                gs.tail = max(gs.tail, gs.floor)
+
+    def _emit(self, body: bytes):
+        self._buf += struct.pack("<III", _MAGIC, len(body), zlib.crc32(body))
+        self._buf += body
+        if self._f.tell() + len(self._buf) >= self.segment_bytes:
+            self._flush()
+            os.fsync(self._f.fileno())
+            self._f.close()
+            self._sid += 1
+            self._segs.append(self._sid)
+            self._f = open(self._seg_path(self._sid), "wb")
+
+    def _flush(self):
+        if self._buf:
+            self._f.write(self._buf)
+            self._buf = bytearray()
+
+    # -- same surface as _NativeWal ------------------------------------
+    def append_entry(self, g, idx, term, payload: bytes):
+        gs = self._g(g)
+        gs.drop_suffix(idx)
+        gs.entries[idx] = (term, bytes(payload))
+        gs.tail = idx
+        self._emit(struct.pack("<BIQQI", _ENTRY, g, idx, term & M64,
+                               len(payload)) + payload)
+
+    def append_stable(self, g, term, ballot):
+        self._g(g).stable = (term, ballot)
+        self._emit(struct.pack("<BIQQ", _STABLE, g, term & M64, ballot & M64))
+
+    def truncate(self, g, frm):
+        self._g(g).drop_suffix(frm)
+        self._emit(struct.pack("<BIQ", _TRUNCATE, g, frm))
+
+    def milestone(self, g, idx, term):
+        gs = self._g(g)
+        if idx > gs.floor:
+            gs.floor, gs.floor_term = idx, term
+            gs.drop_prefix(idx)
+            gs.tail = max(gs.tail, gs.floor)
+        self._emit(struct.pack("<BIQQ", _MILESTONE, g, idx, term & M64))
+
+    def sync(self):
+        self._flush()
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def tail(self, g):
+        return self.groups[g].tail if g in self.groups else 0
+
+    def floor(self, g):
+        return self.groups[g].floor if g in self.groups else 0
+
+    def floor_term(self, g):
+        return self.groups[g].floor_term if g in self.groups else 0
+
+    def stable(self, g):
+        return self.groups[g].stable if g in self.groups else None
+
+    def entry_term(self, g, idx):
+        gs = self.groups.get(g)
+        if gs is None:
+            return -1
+        if idx == gs.floor:
+            return gs.floor_term
+        e = gs.entries.get(idx)
+        return e[0] if e else -1
+
+    def entry_payload(self, g, idx):
+        gs = self.groups.get(g)
+        e = gs.entries.get(idx) if gs else None
+        return e[1] if e else None
+
+    def checkpoint(self):
+        self._flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+        old = list(self._segs)
+        self._sid += 1
+        new_id = self._sid
+        self._segs = [new_id]
+        self._f = open(self._seg_path(new_id), "wb")
+        for g, gs in self.groups.items():
+            if gs.stable is not None:
+                self.append_stable(g, *gs.stable)
+            if gs.floor > 0:
+                self._emit(struct.pack("<BIQQ", _MILESTONE, g, gs.floor,
+                                       gs.floor_term & M64))
+            for idx in sorted(gs.entries):
+                term, payload = gs.entries[idx]
+                self._emit(struct.pack("<BIQQI", _ENTRY, g, idx, term & M64,
+                                       len(payload)) + payload)
+        self.sync()
+        for sid in old:
+            if sid not in self._segs:
+                os.unlink(self._seg_path(sid))
+
+    def segment_count(self):
+        return len(self._segs)
+
+    def close(self):
+        self._flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+
+
+M64 = (1 << 64) - 1
+
+
+def _signed(v: int) -> int:
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def WalStore(path: str, segment_bytes: int = 64 << 20, *,
+             force_python: bool = False):
+    """Open a WAL store at `path`, preferring the native engine."""
+    if not force_python and native_available():
+        return _NativeWal(path, segment_bytes)
+    return PyWal(path, segment_bytes)

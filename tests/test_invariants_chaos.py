@@ -10,7 +10,7 @@ AssertionError oracles lifted out of the hot path, SURVEY.md §4).
 import numpy as np
 import pytest
 
-from rafting_tpu import DeviceCluster, EngineConfig
+from rafting_tpu import LEADER, DeviceCluster, EngineConfig
 from rafting_tpu.testkit import ClusterChecker
 
 
@@ -50,7 +50,7 @@ def test_chaos_small_partitions():
                        rpc_timeout_ticks=5, pre_vote=True)
     c, chk, snap = chaos_run(cfg, seed=3, n_ticks=160)
     # After healing, every group must converge to one leader and commit.
-    assert ((snap["role"] == 3).sum(axis=0) == 1).all()
+    assert ((snap["role"] == LEADER).sum(axis=0) == 1).all()
     assert (snap["commit"].max(axis=0) > 0).all()
 
 
@@ -60,7 +60,7 @@ def test_chaos_five_peers_prevote_churn():
                        max_submit=2, election_ticks=8, heartbeat_ticks=2,
                        rpc_timeout_ticks=6, pre_vote=True)
     c, chk, snap = chaos_run(cfg, seed=5, n_ticks=200, partition_p=0.12)
-    assert ((snap["role"] == 3).sum(axis=0) == 1).all()
+    assert ((snap["role"] == LEADER).sum(axis=0) == 1).all()
     assert (snap["commit"].max(axis=0) > 0).all()
 
 
@@ -86,6 +86,7 @@ def test_chaos_snapshot_catchup():
     live = [n for n in range(3) if n != lagger]
     assert max(snap["base"][n].max() for n in live) > \
         snap["last"][lagger].max(), "live side must compact past the lagger"
+    preheal_last = snap["last"][lagger].copy()
     c.heal()
     for _ in range(60):
         c.tick(submit_n=2)
@@ -99,7 +100,10 @@ def test_chaos_snapshot_catchup():
     # The lagger caught up: its commit matches the cluster frontier.
     frontier = snap["commit"].max(axis=0)
     np.testing.assert_array_equal(snap["commit"][lagger], frontier)
-    assert (snap["base"][lagger] > 0).any(), \
+    # Snapshot install is the only way past the gap: the live side compacted
+    # beyond the lagger's pre-heal tail, so its floor must have jumped over
+    # everything it could have replayed from the log.
+    assert (snap["base"][lagger] > preheal_last).any(), \
         "lagger should have installed at least one snapshot"
 
 

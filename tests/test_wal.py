@@ -1,0 +1,221 @@
+"""Durable log tier tests: WAL engines (native C++ and Python), LogStore,
+crash recovery, and device-state restore.
+
+Covers the reference's storage semantics (SURVEY.md L2a): append/overwrite,
+suffix truncation, milestone floors, stable records persisted before
+replies, torn-write recovery, and compaction GC — on both engines and
+cross-engine (same on-disk format).
+"""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from rafting_tpu.log import LogStore, WalStore, native_available
+from rafting_tpu.log.store import restore_raft_state
+from rafting_tpu.log.wal import PyWal
+
+BACKENDS = ["python"] + (["native"] if native_available() else [])
+
+
+def mk(path, backend):
+    return WalStore(str(path), segment_bytes=1 << 20,
+                    force_python=(backend == "python"))
+
+
+@pytest.fixture(params=BACKENDS)
+def backend(request):
+    return request.param
+
+
+def test_native_builds():
+    assert native_available(), "native WAL engine must compile in this env"
+
+
+def test_roundtrip(tmp_path, backend):
+    w = mk(tmp_path / "w", backend)
+    w.append_stable(3, 7, 1)
+    w.append_entry(3, 1, 5, b"hello")
+    w.append_entry(3, 2, 5, b"")
+    w.append_entry(3, 3, 6, b"world")
+    w.sync()
+    assert w.tail(3) == 3
+    assert w.stable(3) == (7, 1)
+    assert w.entry_term(3, 1) == 5
+    assert w.entry_term(3, 3) == 6
+    assert w.entry_term(3, 4) == -1
+    assert w.entry_payload(3, 1) == b"hello"
+    assert w.entry_payload(3, 2) == b""
+    assert w.entry_payload(3, 3) == b"world"
+    w.close()
+
+
+def test_overwrite_truncates_suffix(tmp_path, backend):
+    w = mk(tmp_path / "w", backend)
+    for i in range(1, 6):
+        w.append_entry(0, i, 1, f"e{i}".encode())
+    # Overwrite index 3 (conflict): 4 and 5 must die.
+    w.append_entry(0, 3, 2, b"new3")
+    assert w.tail(0) == 3
+    assert w.entry_term(0, 3) == 2
+    assert w.entry_term(0, 4) == -1
+    w.truncate(0, 2)
+    assert w.tail(0) == 1
+    w.close()
+
+
+def test_milestone_floor(tmp_path, backend):
+    w = mk(tmp_path / "w", backend)
+    for i in range(1, 8):
+        w.append_entry(0, i, 1, b"x")
+    w.milestone(0, 5, 1)
+    assert w.floor(0) == 5
+    assert w.floor_term(0) == 1
+    assert w.entry_term(0, 5) == 1     # floor reports milestone term
+    assert w.entry_payload(0, 5) is None  # payload compacted away
+    assert w.entry_term(0, 6) == 1
+    assert w.tail(0) == 7
+    # Snapshot-only group: floor beyond tail pulls tail up.
+    w.milestone(9, 42, 3)
+    assert w.tail(9) == 42 and w.floor(9) == 42
+    w.close()
+
+
+def test_reopen_recovers(tmp_path, backend):
+    p = tmp_path / "w"
+    w = mk(p, backend)
+    w.append_stable(1, 4, 2)
+    for i in range(1, 5):
+        w.append_entry(1, i, 4, f"p{i}".encode())
+    w.milestone(1, 2, 4)
+    w.truncate(1, 5)  # no-op: nothing lives at >= 5
+    w.sync()
+    w.close()
+    w2 = mk(p, backend)
+    assert w2.stable(1) == (4, 2)
+    assert w2.floor(1) == 2 and w2.floor_term(1) == 4
+    assert w2.tail(1) == 4
+    assert w2.entry_payload(1, 3) == b"p3"
+    assert w2.entry_payload(1, 2) is None  # at floor
+    w2.close()
+
+
+def test_cross_engine_format(tmp_path):
+    """Files written by one engine are read by the other."""
+    if not native_available():
+        pytest.skip("no native engine")
+    p = tmp_path / "w"
+    w = mk(p, "native")
+    w.append_stable(0, 3, -1)
+    w.append_entry(0, 1, 3, b"abc")
+    w.milestone(5, 10, 2)
+    w.sync()
+    w.close()
+    r = PyWal(str(p))
+    assert r.stable(0) == (3, -1)
+    assert r.entry_payload(0, 1) == b"abc"
+    assert r.floor(5) == 10
+    r.append_entry(0, 2, 3, b"def")
+    r.sync()
+    r.close()
+    w2 = mk(p, "native")
+    assert w2.entry_payload(0, 2) == b"def"
+    assert w2.tail(0) == 2
+    w2.close()
+
+
+def test_torn_tail_dropped(tmp_path, backend):
+    p = tmp_path / "w"
+    w = mk(p, backend)
+    w.append_entry(0, 1, 1, b"good")
+    w.sync()
+    w.close()
+    # Corrupt: append garbage bytes simulating a torn write.
+    seg = os.path.join(p, "00000000.wal")
+    with open(seg, "ab") as f:
+        f.write(struct.pack("<III", 0x52574131, 100, 0xDEAD) + b"short")
+    w2 = mk(p, backend)
+    assert w2.tail(0) == 1
+    assert w2.entry_payload(0, 1) == b"good"
+    # The torn tail was truncated away; appending again keeps a clean log.
+    w2.append_entry(0, 2, 1, b"more")
+    w2.sync()
+    w2.close()
+    w3 = mk(p, backend)
+    assert w3.tail(0) == 2 and w3.entry_payload(0, 2) == b"more"
+    w3.close()
+
+
+def test_segment_rotation_and_checkpoint(tmp_path, backend):
+    w = WalStore(str(tmp_path / "w"), segment_bytes=4096,
+                 force_python=(backend == "python"))
+    payload = b"z" * 256
+    for i in range(1, 101):
+        w.append_entry(0, i, 1, payload)
+    w.sync()
+    assert w.segment_count() > 1
+    w.milestone(0, 90, 1)
+    w.checkpoint()
+    assert w.segment_count() == 1
+    assert w.tail(0) == 100
+    assert w.entry_payload(0, 95) == payload
+    assert w.entry_payload(0, 90) is None
+    w.close()
+    w2 = WalStore(str(tmp_path / "w"), segment_bytes=4096,
+                  force_python=(backend == "python"))
+    assert w2.tail(0) == 100 and w2.floor(0) == 90
+    assert w2.entry_payload(0, 100) == payload
+    w2.close()
+
+
+def test_logstore_tick_protocol(tmp_path):
+    s = LogStore(str(tmp_path / "w"))
+    # Leader accepts 3 entries at term 2.
+    s.append_entries(0, 1, [2, 2, 2], [b"a", b"b", b"c"])
+    s.put_stable(0, 2, 0)
+    s.sync()
+    assert s.payload_batch(0, 1, 3) == [b"a", b"b", b"c"]
+    # Conflict: new leader overwrites from 2 and the tail shrinks.
+    s.append_entries(0, 2, [3], [b"B"])
+    s.truncate_to(0, 2)
+    s.put_stable(0, 3, 1)
+    s.sync()
+    assert s.tail(0) == 2
+    assert s.payload(0, 2) == b"B"
+    assert s.payload(0, 3) is None
+    # Compaction.
+    s.set_floor(0, 1, 2)
+    s.sync()
+    assert s.floor(0) == 1
+    assert s.payload(0, 1) is None  # pruned from cache + WAL index
+    s.close()
+
+
+def test_restore_raft_state(tmp_path):
+    from rafting_tpu.core.types import EngineConfig, NIL
+
+    cfg = EngineConfig(n_groups=4, n_peers=3, log_slots=16, batch=4,
+                       max_submit=4)
+    s = LogStore(str(tmp_path / "w"))
+    # group 0: plain log
+    s.append_entries(0, 1, [1, 1, 2], [b"a", b"b", b"c"])
+    s.put_stable(0, 2, 1)
+    # group 1: compacted log with live suffix
+    s.append_entries(1, 1, [1] * 6, [b"x"] * 6)
+    s.set_floor(1, 4, 1)
+    s.put_stable(1, 1, NIL)
+    # group 2: untouched
+    s.sync()
+    st = restore_raft_state(cfg, node_id=2, store=s, seed=0)
+    assert int(st.term[0]) == 2 and int(st.voted_for[0]) == 1
+    assert int(st.log.last[0]) == 3
+    assert int(st.log.base[1]) == 4 and int(st.log.last[1]) == 6
+    assert int(st.commit[1]) == 4
+    assert int(st.term[2]) == 0 and int(st.voted_for[2]) == NIL
+    assert int(st.log.last[2]) == 0
+    ring = np.asarray(st.log.term)
+    assert ring[0, 3 % 16] == 2
+    assert ring[1, 5 % 16] == 1
+    s.close()
