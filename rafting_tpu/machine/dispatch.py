@@ -1,0 +1,143 @@
+"""ApplyDispatcher: drives state machines from the device commit frontier.
+
+The vectorized analog of the reference's apply loop
+(RaftRoutine.commitState/applyEntry/applyCommand,
+context/RaftRoutine.java:224-306): after each device tick the runtime
+hands the dispatcher the committed-index frontier for all groups; the
+dispatcher applies any newly committed entries in order, completes client
+promises, and reports apply progress (fed back to the device `applied`
+lanes and into the snapshot maintain policy).
+
+Halt/resume mirrors the restore dance (RaftRoutine.restoreCheckpoint
+commitVersion CAS MACHINE_HALT, context/RaftRoutine.java:482-541): while a
+group's snapshot is being installed its applies are frozen, then resumed
+at the recovered frontier.
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent.futures import Future
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from .spi import MachineProvider, RaftMachine
+
+log = logging.getLogger(__name__)
+
+
+class ApplyDispatcher:
+    def __init__(self, provider: MachineProvider, payload_fn,
+                 on_applied: Optional[Callable[[int, int], None]] = None):
+        """payload_fn(group, index) -> bytes | None (usually LogStore.payload).
+
+        on_applied(group, new_last_applied): progress hook (maintain policy).
+        """
+        self._provider = provider
+        self._payload = payload_fn
+        self._machines: Dict[int, RaftMachine] = {}
+        self._halted: Dict[int, bool] = {}
+        self._promises: Dict[tuple, Future] = {}
+        self._on_applied = on_applied
+
+    def machine(self, g: int) -> RaftMachine:
+        m = self._machines.get(g)
+        if m is None:
+            m = self._machines[g] = self._provider.bootstrap(g)
+        return m
+
+    def applied(self, g: int) -> int:
+        return self.machine(g).last_applied()
+
+    # -- client promises ----------------------------------------------------
+
+    def register_promise(self, g: int, index: int, fut: Future) -> None:
+        """A client command was accepted at (g, index); complete its future
+        with the apply result (reference: RaftContext promise map keyed by
+        EntryKey, context/RaftContext.java:223-237)."""
+        self._promises[(g, index)] = fut
+
+    def abort_promises(self, g: int, err: Exception) -> None:
+        """Leadership lost: fail outstanding promises (reference
+        Leader ctor abortPromise, context/RaftContext.java:165-187)."""
+        for key in [k for k in self._promises if k[0] == g]:
+            f = self._promises.pop(key)
+            if not f.done():
+                f.set_exception(err)
+
+    # -- snapshot halt/resume ------------------------------------------------
+
+    def halt(self, g: int) -> None:
+        self._halted[g] = True
+
+    def resume_from(self, g: int, checkpoint) -> None:
+        """Install a snapshot into the machine and resume applies.
+
+        Promises at or below the checkpoint index can never be completed by
+        an apply (the machine jumps over them), so they are aborted — their
+        commands committed cluster-wide but the result is unobservable here.
+        """
+        self.machine(g).recover(checkpoint)
+        for key in [k for k in self._promises
+                    if k[0] == g and k[1] <= checkpoint.index]:
+            f = self._promises.pop(key)
+            if not f.done():
+                f.set_exception(RuntimeError(
+                    "entry applied via snapshot; result unavailable"))
+        self._halted[g] = False
+
+    # -- the apply loop -----------------------------------------------------
+
+    def advance(self, commit: np.ndarray,
+                groups: Optional[np.ndarray] = None,
+                max_per_group: int = 0) -> None:
+        """Apply newly committed entries.  `commit` is the [G] frontier;
+        `groups` optionally restricts which lanes are live (active mask or
+        index list).  `max_per_group` bounds work per call (0 = no bound)."""
+        if groups is None:
+            gs = np.nonzero(commit > 0)[0]
+        elif groups.dtype == bool:
+            gs = np.nonzero(groups & (commit > 0))[0]
+        else:
+            gs = groups
+        for g in gs:
+            g = int(g)
+            if self._halted.get(g):
+                continue
+            m = self.machine(g)
+            target = int(commit[g])
+            before = m.last_applied()
+            idx = before + 1
+            hi = target if max_per_group <= 0 \
+                else min(target, idx + max_per_group - 1)
+            while idx <= hi:
+                payload = self._payload(g, idx)
+                if payload is None:
+                    # Frontier ahead of locally stored entries (e.g. device
+                    # committed via snapshot milestone); the machine must
+                    # catch up via recover, not apply.
+                    break
+                try:
+                    result = m.apply(idx, payload)
+                except Exception as e:  # retry next round (reference
+                    # RetryCommandException, RaftRoutine.java:288-300)
+                    log.warning("apply failed g=%d idx=%d: %s", g, idx, e)
+                    break
+                fut = self._promises.pop((g, idx), None)
+                if fut is not None and not fut.done():
+                    fut.set_result(result)
+                idx += 1
+            if self._on_applied is not None and idx - 1 > before:
+                self._on_applied(g, idx - 1)
+
+    def applied_frontier(self, n_groups: int) -> np.ndarray:
+        out = np.zeros(n_groups, np.int32)
+        for g, m in self._machines.items():
+            out[g] = m.last_applied()
+        return out
+
+    def close(self) -> None:
+        for m in self._machines.values():
+            m.close()
+        self._machines.clear()

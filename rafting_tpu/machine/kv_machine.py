@@ -1,0 +1,95 @@
+"""KVMachine: an in-memory key-value state machine with file checkpoints.
+
+The "real application" example machine: commands are simple serialized
+ops (set/del), checkpoints dump the dict to a file.  Used by examples and
+as the substrate under the admin meta-group's MVCC engine.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Dict, Optional
+
+from .spi import Checkpoint
+
+
+class KVMachine:
+    """Commands: JSON bytes {"op": "set"|"del", "k": str, "v": any}."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.data: Dict[str, Any] = {}
+        self._last_applied = 0
+        if os.path.exists(path):
+            with open(path) as f:
+                dump = json.load(f)
+            self.data = dump["data"]
+            self._last_applied = dump["index"]
+
+    def last_applied(self) -> int:
+        return self._last_applied
+
+    def apply(self, index: int, payload: bytes) -> Any:
+        assert index == self._last_applied + 1
+        cmd = json.loads(payload)
+        op = cmd.get("op")
+        result = None
+        if op == "set":
+            self.data[cmd["k"]] = cmd["v"]
+            result = cmd["v"]
+        elif op == "del":
+            result = self.data.pop(cmd["k"], None)
+        elif op == "get":
+            result = self.data.get(cmd["k"])
+        self._last_applied = index
+        return result
+
+    def _dump(self, path: str) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"index": self._last_applied, "data": self.data}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def checkpoint(self, must_include: int) -> Checkpoint:
+        assert self._last_applied >= must_include
+        self._prune_ckpts()
+        p = f"{self.path}.ckpt.{self._last_applied}"
+        self._dump(p)
+        return Checkpoint(path=p, index=self._last_applied)
+
+    def _prune_ckpts(self) -> None:
+        for p in glob.glob(f"{self.path}.ckpt.*"):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+    def recover(self, checkpoint: Checkpoint) -> None:
+        with open(checkpoint.path) as f:
+            dump = json.load(f)
+        self.data = dump["data"]
+        self._last_applied = dump["index"]
+        self._dump(self.path)
+
+    def close(self) -> None:
+        self._dump(self.path)
+
+    def destroy(self) -> None:
+        self._prune_ckpts()
+        for p in (self.path, self.path + ".tmp"):
+            if os.path.exists(p):
+                os.unlink(p)
+
+
+class KVMachineProvider:
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def bootstrap(self, group: int) -> KVMachine:
+        return KVMachine(os.path.join(self.root, f"kv_{group}.json"))

@@ -1,0 +1,48 @@
+"""SPI contracts: RaftMachine, MachineProvider, Checkpoint."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Protocol, runtime_checkable
+
+
+@dataclasses.dataclass(frozen=True)
+class Checkpoint:
+    """A durable state-machine snapshot: file path + the log index it
+    includes (reference RaftMachine.Checkpoint, command/RaftMachine.java:18-28)."""
+    path: str
+    index: int
+
+
+@runtime_checkable
+class RaftMachine(Protocol):
+    """Per-group replicated state machine (command/RaftMachine.java:12-63).
+
+    Contract:
+    * :meth:`apply` is called exactly once per committed index, in index
+      order, starting at ``last_applied() + 1``.  It must be atomic: apply
+      fully or raise (a raise halts the group's apply frontier; the
+      dispatcher retries later — reference RetryCommandException semantics,
+      support/anomaly/RetryCommandException.java:10-25).
+    * :meth:`checkpoint` produces a durable snapshot whose index is at
+      least ``must_include`` (may block; called off the apply path).
+    * :meth:`recover` atomically replaces state from a checkpoint.
+    """
+
+    def last_applied(self) -> int: ...
+
+    def apply(self, index: int, payload: bytes) -> Any: ...
+
+    def checkpoint(self, must_include: int) -> Checkpoint: ...
+
+    def recover(self, checkpoint: Checkpoint) -> None: ...
+
+    def close(self) -> None: ...
+
+    def destroy(self) -> None: ...
+
+
+class MachineProvider(Protocol):
+    """Factory for per-group machines (command/spi/MachineProvider.java:9-13)."""
+
+    def bootstrap(self, group: int) -> RaftMachine: ...

@@ -1,0 +1,159 @@
+"""SnapshotArchive: durable per-group snapshot store.
+
+Disk layout mirrors the reference (command/SnapshotArchive.java:110-242):
+one directory per group holding files named ``snapshot_<index:016x>_<term:016x>``,
+installed by atomic rename, retaining the last N (reference keeps 5,
+context/ContextManager.java:72).  Temp files from interrupted transfers
+are swept at open (SnapshotArchive.java:127-132).  A PendingSnapshot
+tracks at most one in-flight remote download per group
+(SnapshotArchive.java:197-211).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import shutil
+from typing import Dict, List, Optional
+
+_NAME = re.compile(r"^snapshot_([0-9a-f]{16})_([0-9a-f]{16})$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Snapshot:
+    path: str
+    index: int
+    term: int
+
+
+@dataclasses.dataclass
+class PendingSnapshot:
+    """One in-flight snapshot download/install for a group."""
+    index: int
+    term: int
+    from_peer: int
+    failed: bool = False
+
+    def expired_by(self, index: int, term: int) -> bool:
+        """A newer offer supersedes this one (reference PendingSnapshot
+        ordering, SnapshotArchive.java:30-76)."""
+        return (term, index) > (self.term, self.index)
+
+
+class SnapshotArchive:
+    def __init__(self, root: str, retain: int = 5):
+        self.root = root
+        self.retain = retain
+        os.makedirs(root, exist_ok=True)
+        self._pending: Dict[int, PendingSnapshot] = {}
+        # Sweep temp droppings from interrupted installs.
+        for name in os.listdir(root):
+            if name.endswith(".tmp"):
+                try:
+                    os.unlink(os.path.join(root, name))
+                except OSError:
+                    pass
+            elif os.path.isdir(os.path.join(root, name)):
+                gdir = os.path.join(root, name)
+                for f in os.listdir(gdir):
+                    if f.endswith(".tmp"):
+                        try:
+                            os.unlink(os.path.join(gdir, f))
+                        except OSError:
+                            pass
+
+    def _gdir(self, g: int) -> str:
+        d = os.path.join(self.root, f"g{g}")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    # -- local snapshots -----------------------------------------------------
+
+    def save_checkpoint(self, g: int, src_path: str, index: int,
+                        term: int) -> Snapshot:
+        """Archive a machine checkpoint as the group's newest snapshot
+        (atomic move; ordering asserted like SnapshotArchive.java:138-182)."""
+        last = self.last_snapshot(g)
+        if last is not None:
+            assert (term, index) >= (last.term, last.index), \
+                f"snapshot ordering violated: ({index},{term}) after " \
+                f"({last.index},{last.term})"
+            if (index, term) == (last.index, last.term):
+                return last
+        dst = os.path.join(self._gdir(g), f"snapshot_{index:016x}_{term:016x}")
+        tmp = dst + ".tmp"
+        shutil.copyfile(src_path, tmp)
+        os.replace(tmp, dst)
+        self._prune(g)
+        return Snapshot(dst, index, term)
+
+    def last_snapshot(self, g: int) -> Optional[Snapshot]:
+        snaps = self.list_snapshots(g)
+        return snaps[-1] if snaps else None
+
+    def list_snapshots(self, g: int) -> List[Snapshot]:
+        d = self._gdir(g)
+        out = []
+        for name in os.listdir(d):
+            m = _NAME.match(name)
+            if m:
+                out.append(Snapshot(os.path.join(d, name),
+                                    int(m.group(1), 16), int(m.group(2), 16)))
+        out.sort(key=lambda s: (s.term, s.index))
+        return out
+
+    def _prune(self, g: int) -> None:
+        snaps = self.list_snapshots(g)
+        for s in snaps[:-self.retain]:
+            try:
+                os.unlink(s.path)
+            except OSError:
+                pass
+
+    # -- remote installs -----------------------------------------------------
+
+    def pend_snapshot(self, g: int, index: int, term: int,
+                      from_peer: int) -> Optional[PendingSnapshot]:
+        """Register an in-flight download unless one is already pending for
+        an equal-or-newer milestone.  Returns the new pending record, or
+        None if the existing one stands (SnapshotArchive.java:197-211)."""
+        cur = self._pending.get(g)
+        if cur is not None and not cur.failed and \
+                not cur.expired_by(index, term):
+            return None
+        p = PendingSnapshot(index=index, term=term, from_peer=from_peer)
+        self._pending[g] = p
+        return p
+
+    def pending(self, g: int) -> Optional[PendingSnapshot]:
+        return self._pending.get(g)
+
+    def install_pending(self, g: int, data_path: str) -> Snapshot:
+        """Download finished: atomically archive the received snapshot.
+
+        If a newer snapshot was archived locally while the download was in
+        flight (local checkpoint racing the transfer), the download is
+        discarded and the newer local snapshot is returned instead — the
+        caller recovers from whichever is returned."""
+        p = self._pending.get(g)
+        assert p is not None, "no pending snapshot"
+        try:
+            last = self.last_snapshot(g)
+            if last is not None and (last.term, last.index) > (p.term, p.index):
+                return last
+            return self.save_checkpoint(g, data_path, p.index, p.term)
+        finally:
+            del self._pending[g]
+
+    def fail_pending(self, g: int) -> None:
+        p = self._pending.get(g)
+        if p is not None:
+            p.failed = True
+
+    def clear_pending(self, g: int) -> None:
+        self._pending.pop(g, None)
+
+    def destroy(self, g: int) -> None:
+        shutil.rmtree(self._gdir(g), ignore_errors=True)
+        self._pending.pop(g, None)

@@ -1,0 +1,217 @@
+"""Tests for the state-machine SPI, apply dispatcher, snapshot archive and
+maintain policy (reference parity: SURVEY.md §2 L2a + §5 checkpoint/resume,
+test model: command/SnapshotTest.java + cluster/cmd/FileMachine.java)."""
+
+import os
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from rafting_tpu.machine import (
+    ApplyDispatcher, FileMachine, FileMachineProvider, KVMachine,
+    KVMachineProvider,
+)
+from rafting_tpu.snapshot import MaintainAgreement, SnapshotArchive
+
+
+# ---------------------------------------------------------------- machines
+
+def test_file_machine_roundtrip(tmp_path):
+    m = FileMachine(str(tmp_path / "m.txt"))
+    assert m.last_applied() == 0
+    m.apply(1, b"alpha")
+    m.apply(2, b"beta")
+    assert m.last_applied() == 2
+    ck = m.checkpoint(1)
+    assert ck.index == 2
+    m.apply(3, b"gamma")
+    # Recover to the checkpoint: prefix-compatible, rolls back to index 2.
+    m.recover(ck)
+    assert m.last_applied() == 2
+    assert m.lines() == ["1:alpha\n", "2:beta\n"]
+    m.close()
+    # Reopen recounts last_applied from the file.
+    m2 = FileMachine(str(tmp_path / "m.txt"))
+    assert m2.last_applied() == 2
+    m2.close()
+
+
+def test_file_machine_detects_divergence(tmp_path):
+    a = FileMachine(str(tmp_path / "a.txt"))
+    a.apply(1, b"x")
+    ck = a.checkpoint(1)
+    b = FileMachine(str(tmp_path / "b.txt"))
+    b.apply(1, b"DIFFERENT")
+    with pytest.raises(AssertionError):
+        b.recover(ck)
+    a.close()
+    b.close()
+
+
+def test_kv_machine(tmp_path):
+    m = KVMachine(str(tmp_path / "kv.json"))
+    m.apply(1, b'{"op": "set", "k": "a", "v": 1}')
+    m.apply(2, b'{"op": "set", "k": "b", "v": [2, 3]}')
+    assert m.apply(3, b'{"op": "get", "k": "a"}') == 1
+    ck = m.checkpoint(2)
+    m.apply(4, b'{"op": "del", "k": "a"}')
+    m.recover(ck)
+    assert m.data == {"a": 1, "b": [2, 3]}
+    assert m.last_applied() == 3
+    m.close()
+    m2 = KVMachine(str(tmp_path / "kv.json"))
+    assert m2.last_applied() == 3 and m2.data["a"] == 1
+    m2.close()
+
+
+# ---------------------------------------------------------------- dispatcher
+
+def test_dispatcher_applies_in_order_and_completes_promises(tmp_path):
+    store = {}
+    for i in range(1, 6):
+        store[(0, i)] = f"cmd{i}".encode()
+        store[(2, i)] = f"two{i}".encode()
+    d = ApplyDispatcher(FileMachineProvider(str(tmp_path)),
+                        lambda g, i: store.get((g, i)))
+    f3 = Future()
+    d.register_promise(0, 3, f3)
+    commit = np.array([3, 0, 5], np.int32)
+    d.advance(commit)
+    assert d.applied(0) == 3 and d.applied(2) == 5
+    assert f3.result(timeout=0) == 3
+    # Frontier moves; only the delta is applied.
+    commit[0] = 5
+    d.advance(commit)
+    assert d.applied(0) == 5
+    assert d.machine(0).lines() == [f"{i}:cmd{i}\n" for i in range(1, 6)]
+    d.close()
+
+
+def test_dispatcher_halt_resume(tmp_path):
+    store = {(0, i): b"x%d" % i for i in range(1, 10)}
+    d = ApplyDispatcher(FileMachineProvider(str(tmp_path)),
+                        lambda g, i: store.get((g, i)))
+    d.advance(np.array([2], np.int32))
+    assert d.applied(0) == 2
+    d.halt(0)
+    d.advance(np.array([6], np.int32))
+    assert d.applied(0) == 2, "halted group must not apply"
+    # Simulate snapshot install at index 6 from a donor machine.
+    donor = FileMachine(str(tmp_path / "donor.txt"))
+    for i in range(1, 7):
+        donor.apply(i, b"x%d" % i)
+    ck = donor.checkpoint(6)
+    d.resume_from(0, ck)
+    assert d.applied(0) == 6
+    d.advance(np.array([8], np.int32))
+    assert d.applied(0) == 8
+    donor.close()
+    d.close()
+
+
+def test_dispatcher_abort_promises(tmp_path):
+    d = ApplyDispatcher(FileMachineProvider(str(tmp_path)), lambda g, i: None)
+    f = Future()
+    d.register_promise(1, 7, f)
+    d.abort_promises(1, RuntimeError("not leader"))
+    with pytest.raises(RuntimeError):
+        f.result(timeout=0)
+    d.close()
+
+
+def test_dispatcher_missing_payload_stops(tmp_path):
+    """Frontier ahead of stored entries (snapshot commit) must not crash."""
+    d = ApplyDispatcher(FileMachineProvider(str(tmp_path)),
+                        lambda g, i: b"p" if i <= 2 else None)
+    d.advance(np.array([5], np.int32))
+    assert d.applied(0) == 2
+    d.close()
+
+
+# ---------------------------------------------------------------- archive
+
+def test_archive_save_retention_order(tmp_path):
+    a = SnapshotArchive(str(tmp_path / "arch"), retain=3)
+    src = tmp_path / "state"
+    for i in range(1, 6):
+        src.write_text(f"state-{i}")
+        a.save_checkpoint(0, str(src), index=i * 10, term=1)
+    snaps = a.list_snapshots(0)
+    assert len(snaps) == 3, "retention must prune to last 3"
+    assert [s.index for s in snaps] == [30, 40, 50]
+    last = a.last_snapshot(0)
+    assert last.index == 50
+    with open(last.path) as f:
+        assert f.read() == "state-5"
+    # Ordering violation rejected.
+    src.write_text("old")
+    with pytest.raises(AssertionError):
+        a.save_checkpoint(0, str(src), index=5, term=0)
+
+
+def test_archive_pending_lifecycle(tmp_path):
+    a = SnapshotArchive(str(tmp_path / "arch"))
+    p = a.pend_snapshot(0, index=100, term=3, from_peer=1)
+    assert p is not None
+    # Duplicate/older offers don't replace it.
+    assert a.pend_snapshot(0, index=100, term=3, from_peer=2) is None
+    assert a.pend_snapshot(0, index=90, term=3, from_peer=2) is None
+    # A newer offer supersedes.
+    p2 = a.pend_snapshot(0, index=120, term=4, from_peer=2)
+    assert p2 is not None and p2.from_peer == 2
+    data = tmp_path / "dl"
+    data.write_text("snapshot-bytes")
+    snap = a.install_pending(0, str(data))
+    assert (snap.index, snap.term) == (120, 4)
+    assert a.pending(0) is None
+    assert a.last_snapshot(0).index == 120
+    # Failed pending can be replaced by a same-milestone retry.
+    a.pend_snapshot(0, index=130, term=4, from_peer=1)
+    a.fail_pending(0)
+    assert a.pend_snapshot(0, index=130, term=4, from_peer=2) is not None
+
+
+def test_archive_sweeps_temps(tmp_path):
+    root = tmp_path / "arch"
+    g0 = root / "g0"
+    g0.mkdir(parents=True)
+    (g0 / "snapshot_0000000000000064_0000000000000001").write_text("ok")
+    (g0 / "junk.tmp").write_text("torn")
+    a = SnapshotArchive(str(root))
+    assert not (g0 / "junk.tmp").exists()
+    assert a.last_snapshot(0).index == 0x64
+
+
+# ---------------------------------------------------------------- policy
+
+def test_maintain_policy_thresholds():
+    ma = MaintainAgreement(3, state_change_threshold=10,
+                           dirty_log_tolerance=5, snap_min_interval=4,
+                           compact_min_interval=2, compact_slack=2)
+    applied = np.array([12, 3, 12], np.int64)
+    base = np.array([0, 0, 10], np.int64)
+    need = ma.need_checkpoint(now=10, applied=applied, log_base=base)
+    # g0: changed=12>=10, dirty=12>=5 -> yes. g1: changed 3 -> no.
+    # g2: dirty=2 < 5 -> no.
+    assert list(need) == [True, False, False]
+    ma.note_checkpoint(0, now=10, index=12)
+    # Too soon after the last snapshot.
+    assert not ma.need_checkpoint(11, applied + 20, base)[0] or \
+        ma.need_checkpoint(11, applied + 20, base)[0] == (11 - 10 >= 4)
+    # After the interval, more changes retrigger.
+    assert ma.need_checkpoint(20, np.array([30, 3, 12], np.int64), base)[0]
+
+
+def test_maintain_policy_compaction_gated_on_snapshot():
+    ma = MaintainAgreement(2, compact_min_interval=1, compact_slack=2)
+    commit = np.array([50, 50], np.int64)
+    base = np.array([0, 0], np.int64)
+    # No snapshot yet -> no compaction.
+    assert list(ma.compact_targets(5, commit, base)) == [0, 0]
+    ma.note_checkpoint(0, now=5, index=40)
+    t = ma.compact_targets(10, commit, base)
+    assert t[0] == 40 and t[1] == 0  # min(snap=40, commit-slack=48)
+    ma.note_checkpoint(1, now=10, index=49)
+    t = ma.compact_targets(15, commit, base)
+    assert t[1] == 48  # min(snap=49, commit-slack=48)
