@@ -40,6 +40,7 @@ class ApplyDispatcher:
         self._halted: Dict[int, bool] = {}
         self._promises: Dict[tuple, Future] = {}
         self._on_applied = on_applied
+        self._retry_counts: Dict[tuple, int] = {}
 
     def machine(self, g: int) -> RaftMachine:
         m = self._machines.get(g)
@@ -120,10 +121,20 @@ class ApplyDispatcher:
                     break
                 try:
                     result = m.apply(idx, payload)
-                except Exception as e:  # retry next round (reference
-                    # RetryCommandException, RaftRoutine.java:288-300)
-                    log.warning("apply failed g=%d idx=%d: %s", g, idx, e)
+                except Exception as e:
+                    # Retry next round (reference RetryCommandException,
+                    # RaftRoutine.java:288-300).  A deterministic failure
+                    # freezes the group's apply frontier on purpose —
+                    # skipping a committed entry would diverge replicas —
+                    # but escalate so the operator sees a stuck group.
+                    n = self._retry_counts[(g, idx)] = \
+                        self._retry_counts.get((g, idx), 0) + 1
+                    lvl = log.error if n in (10, 100) or n % 1000 == 0 \
+                        else log.warning
+                    lvl("apply failed g=%d idx=%d (attempt %d): %s",
+                        g, idx, n, e)
                     break
+                self._retry_counts.pop((g, idx), None)
                 fut = self._promises.pop((g, idx), None)
                 if fut is not None and not fut.done():
                     fut.set_result(result)

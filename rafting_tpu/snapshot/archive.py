@@ -129,20 +129,26 @@ class SnapshotArchive:
     def pending(self, g: int) -> Optional[PendingSnapshot]:
         return self._pending.get(g)
 
-    def install_pending(self, g: int, data_path: str) -> Snapshot:
+    def install_pending(self, g: int, data_path: str,
+                        index: Optional[int] = None,
+                        term: Optional[int] = None) -> Snapshot:
         """Download finished: atomically archive the received snapshot.
 
-        If a newer snapshot was archived locally while the download was in
-        flight (local checkpoint racing the transfer), the download is
-        discarded and the newer local snapshot is returned instead — the
-        caller recovers from whichever is returned."""
+        ``index``/``term`` are the milestone the serving peer ACTUALLY
+        returned (it may serve a newer snapshot than requested); they default
+        to the pending request's milestone.  If a newer snapshot was archived
+        locally while the download was in flight (local checkpoint racing the
+        transfer), the download is discarded and the newer local snapshot is
+        returned instead — the caller recovers from whichever is returned."""
         p = self._pending.get(g)
         assert p is not None, "no pending snapshot"
+        index = p.index if index is None else index
+        term = p.term if term is None else term
         try:
             last = self.last_snapshot(g)
-            if last is not None and (last.term, last.index) > (p.term, p.index):
+            if last is not None and (last.term, last.index) > (term, index):
                 return last
-            return self.save_checkpoint(g, data_path, p.index, p.term)
+            return self.save_checkpoint(g, data_path, index, term)
         finally:
             del self._pending[g]
 

@@ -1,0 +1,229 @@
+"""Wire codec for the host transport plane.
+
+The reference moves RPCs one Java object at a time through a custom Netty
+frame protocol (transport/EventCodec.java:25-40 — SOH/STX framing, Kryo
+bodies, 64MB cap).  Here the unit of transfer is a *tick slice*: everything
+one node says to one peer in one engine tick, for all groups at once, packed
+as sparse columns of the dense ``Messages`` arrays (only groups with a valid
+message travel).  This is the wire analog of the reference's single
+scope-multiplexed connection per peer (transport/NettyNode.java:54-74) with
+the per-RPC overhead amortized across every group.
+
+Frame format (all little-endian):
+    magic u32 | type u8 | body_len u32 | crc32(body) u32 | body
+
+Types:
+    HELLO     — connection handshake: (node_id, G, P, B) shape contract
+                (reference ShakeHandEvent, transport/EventBus.java:71-97)
+    MSGS      — one tick slice (see ``pack_slice``)
+    SNAP_REQ  — snapshot fetch request: (group, index, term)
+                (reference WaitSnapEvent, transport/event/WaitSnapEvent.java:8-38)
+    SNAP_DATA — snapshot response: (group, index, term, ok, payload)
+                (reference TransSnapEvent + raw transfer,
+                transport/event/TransSnapEvent.java:8-64)
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+MAGIC = 0x54505552  # "RUPT"
+HELLO, MSGS, SNAP_REQ, SNAP_DATA = 1, 2, 3, 4
+
+MAX_BODY = 64 << 20  # 64 MB cap, matching the reference (EventCodec.java:26)
+
+_HDR = struct.Struct("<IBII")
+
+# Message kinds -> (valid flag field, data fields).  Field order is the wire
+# order; dtypes/shapes come from the Messages template at pack/unpack time.
+KIND_FIELDS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
+    "ae": ("ae_valid", ("ae_term", "ae_prev_idx", "ae_prev_term",
+                        "ae_commit", "ae_n", "ae_ents")),
+    "aer": ("aer_valid", ("aer_term", "aer_success", "aer_match")),
+    "rv": ("rv_valid", ("rv_term", "rv_last_idx", "rv_last_term",
+                        "rv_prevote")),
+    "rvr": ("rvr_valid", ("rvr_term", "rvr_granted", "rvr_prevote",
+                          "rvr_echo")),
+    "is": ("is_valid", ("is_term", "is_idx", "is_last_term")),
+    "isr": ("isr_valid", ("isr_term", "isr_success")),
+}
+KIND_IDS = {k: i for i, k in enumerate(KIND_FIELDS)}
+KIND_BY_ID = {i: k for k, i in KIND_IDS.items()}
+
+
+def frame(ftype: int, body: bytes) -> bytes:
+    assert len(body) <= MAX_BODY
+    return _HDR.pack(MAGIC, ftype, len(body), zlib.crc32(body)) + body
+
+
+class FrameReader:
+    """Incremental frame decoder over a byte stream (the stateful analog of
+    the reference's FrameDecoder, transport/EventCodec.java:219-335)."""
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> List[Tuple[int, bytes]]:
+        self._buf += data
+        out = []
+        while True:
+            if len(self._buf) < _HDR.size:
+                break
+            magic, ftype, blen, crc = _HDR.unpack_from(self._buf, 0)
+            if magic != MAGIC or blen > MAX_BODY:
+                raise IOError(f"bad frame header (magic={magic:#x})")
+            if len(self._buf) < _HDR.size + blen:
+                break
+            body = bytes(self._buf[_HDR.size:_HDR.size + blen])
+            if zlib.crc32(body) != crc:
+                raise IOError("frame CRC mismatch")
+            del self._buf[:_HDR.size + blen]
+            out.append((ftype, body))
+        return out
+
+
+def pack_hello(node_id: int, G: int, P: int, B: int) -> bytes:
+    return frame(HELLO, struct.pack("<IIII", node_id, G, P, B))
+
+
+def unpack_hello(body: bytes) -> Tuple[int, int, int, int]:
+    return struct.unpack("<IIII", body)
+
+
+def pack_snap_req(group: int, index: int, term: int) -> bytes:
+    return frame(SNAP_REQ, struct.pack("<IQq", group, index, term))
+
+
+def unpack_snap_req(body: bytes) -> Tuple[int, int, int]:
+    return struct.unpack("<IQq", body)
+
+
+def pack_snap_data(group: int, index: int, term: int, ok: bool,
+                   payload: bytes) -> bytes:
+    head = struct.pack("<IQqB", group, index, term, 1 if ok else 0)
+    return frame(SNAP_DATA, head + payload)
+
+
+def unpack_snap_data(body: bytes) -> Tuple[int, int, int, bool, bytes]:
+    group, index, term, ok = struct.unpack_from("<IQqB", body, 0)
+    return group, index, term, bool(ok), body[struct.calcsize("<IQqB"):]
+
+
+def pack_slice(src: int, fields: Dict[str, np.ndarray],
+               payload_fn: Optional[Callable[[int, int], Optional[bytes]]]
+               ) -> Optional[bytes]:
+    """Pack one destination's tick slice into a MSGS frame body.
+
+    ``fields`` maps Messages field name -> numpy array of shape [G] or
+    [G, B] (this destination's slice of the outbox).  ``payload_fn(g, idx)``
+    supplies AppendEntries command payloads (LogStore.payload).  Returns
+    None when the slice is empty (nothing valid for this peer).
+    """
+    parts = [struct.pack("<IB", src, len(KIND_FIELDS))]
+    n_total = 0
+    for kind, (vfield, dfields) in KIND_FIELDS.items():
+        valid = fields[vfield]
+        cols = np.nonzero(valid)[0].astype(np.uint32)
+        blob_section = b""
+        if kind == "ae" and len(cols):
+            # Resolve payloads for indices prev_idx+1 .. prev_idx+n per
+            # column FIRST; a column whose payload is unavailable (e.g.
+            # compacted between outbox build and pack) is dropped entirely —
+            # indistinguishable from network loss, which the engine's
+            # resend/timeout path already recovers.  Shipping a substitute
+            # empty command would silently diverge replica state.
+            prevs = fields["ae_prev_idx"][cols]
+            ns = fields["ae_n"][cols]
+            keep, blobs = [], []
+            for g, prev, n in zip(cols.tolist(), prevs.tolist(), ns.tolist()):
+                col_blobs = []
+                for idx in range(prev + 1, prev + 1 + n):
+                    p = payload_fn(int(g), int(idx)) \
+                        if payload_fn is not None else None
+                    if p is None:
+                        col_blobs = None
+                        break
+                    col_blobs.append(struct.pack("<I", len(p)) + p)
+                if col_blobs is not None:
+                    keep.append(g)
+                    blobs.extend(col_blobs)
+            cols = np.asarray(keep, np.uint32)
+            blob_section = b"".join(blobs)
+        n_total += len(cols)
+        parts.append(struct.pack("<BI", KIND_IDS[kind], len(cols)))
+        if len(cols) == 0:
+            continue
+        parts.append(cols.tobytes())
+        for f in dfields:
+            arr = fields[f][cols]
+            parts.append(np.ascontiguousarray(arr).tobytes())
+        parts.append(blob_section)
+    if n_total == 0:
+        return None
+    return frame(MSGS, b"".join(parts))
+
+
+def unpack_slice(body: bytes, template: Dict[str, Tuple[np.dtype, tuple]],
+                 n_groups: Optional[int] = None
+                 ) -> Tuple[int, Dict[str, Tuple[np.ndarray, np.ndarray]],
+                            Dict[Tuple[int, int], bytes]]:
+    """Unpack a MSGS body.
+
+    ``template`` maps field name -> (dtype, per-group trailing shape), e.g.
+    ae_ents -> (int32, (B,)).  Returns (src, {field: (cols, values)},
+    {(group, index): payload}).  ``n_groups`` bounds-checks column ids so a
+    corrupt or shape-mismatched frame can't scatter out of range.
+    """
+    src, n_kinds = struct.unpack_from("<IB", body, 0)
+    off = struct.calcsize("<IB")
+    out: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+    payloads: Dict[Tuple[int, int], bytes] = {}
+    for _ in range(n_kinds):
+        kid, n_cols = struct.unpack_from("<BI", body, off)
+        off += struct.calcsize("<BI")
+        kind = KIND_BY_ID[kid]
+        vfield, dfields = KIND_FIELDS[kind]
+        if n_cols == 0:
+            continue
+        cols = np.frombuffer(body, np.uint32, n_cols, off).astype(np.int64)
+        if n_groups is not None and cols.size and int(cols.max()) >= n_groups:
+            raise IOError("column id out of range (shape mismatch?)")
+        off += 4 * n_cols
+        out[vfield] = (cols, np.ones(n_cols, bool))
+        for f in dfields:
+            dt, trail = template[f]
+            count = n_cols * int(np.prod(trail, dtype=np.int64)) \
+                if trail else n_cols
+            vals = np.frombuffer(body, dt, count, off).reshape(
+                (n_cols,) + trail)
+            off += vals.nbytes
+            out[f] = (cols, vals)
+        if kind == "ae":
+            prevs = out["ae_prev_idx"][1]
+            ns = out["ae_n"][1]
+            for g, prev, n in zip(cols.tolist(), prevs.tolist(), ns.tolist()):
+                for idx in range(int(prev) + 1, int(prev) + 1 + int(n)):
+                    (plen,) = struct.unpack_from("<I", body, off)
+                    off += 4
+                    payloads[(int(g), idx)] = body[off:off + plen]
+                    off += plen
+    return src, out, payloads
+
+
+def messages_template(cfg) -> Dict[str, Tuple[np.dtype, tuple]]:
+    """Field -> (dtype, trailing shape beyond [P, G]) from a Messages.empty."""
+    from ..core.types import Messages
+
+    m = Messages.empty(cfg)
+    out = {}
+    for name in dir(m):
+        if name.startswith("_"):
+            continue
+        v = getattr(m, name)
+        if hasattr(v, "shape") and hasattr(v, "dtype"):
+            out[name] = (np.dtype(v.dtype), tuple(v.shape[2:]))
+    return out

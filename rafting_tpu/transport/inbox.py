@@ -1,0 +1,90 @@
+"""InboxAccumulator: merges asynchronously arriving peer slices into the
+dense per-tick inbox the engine consumes.
+
+Nodes tick independently; a peer may deliver zero, one or several slices
+between two local ticks.  Per (kind, src, group) the *latest* message wins —
+overwrite-merge.  This is safe for Raft: every RPC is either idempotent or
+re-sent on timeout (the engine's ``awaiting``/``rpc_timeout_ticks`` resend
+path), so dropping a superseded message is indistinguishable from network
+loss, which the protocol already tolerates.  The reference gets the same
+effect from per-request timeouts + stale-reply term fencing
+(transport/rpc/AsyncService.java:120-132, context/member/Leader.java:224-227).
+
+AppendEntries payload bytes ride with their frame and are staged here until
+the engine accepts the entries (StepInfo.appended_from/to), at which point
+the runtime moves them into the durable LogStore.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .codec import KIND_FIELDS
+
+
+class InboxAccumulator:
+    def __init__(self, cfg, template: Dict[str, Tuple[np.dtype, tuple]]):
+        self.cfg = cfg
+        self.template = template
+        self._lock = threading.Lock()
+        P, G = cfg.n_peers, cfg.n_groups
+        self._arrays: Dict[str, np.ndarray] = {
+            name: np.zeros((P, G) + trail, dt)
+            for name, (dt, trail) in template.items()
+        }
+        self._valid_fields = [v for v, _ in KIND_FIELDS.values()]
+        # payload staging: (src, group, index) -> bytes
+        self._payloads: Dict[Tuple[int, int, int], bytes] = {}
+        self._dirty = False
+
+    def merge(self, src: int,
+              fields: Dict[str, Tuple[np.ndarray, np.ndarray]],
+              payloads: Dict[Tuple[int, int], bytes]) -> None:
+        """Merge one unpacked slice from peer ``src`` (codec.unpack_slice)."""
+        with self._lock:
+            for name, (cols, vals) in fields.items():
+                self._arrays[name][src, cols] = vals
+            for (g, idx), p in payloads.items():
+                self._payloads[(src, g, idx)] = p
+            self._dirty = True
+
+    def merge_dense(self, src: int, fields: Dict[str, np.ndarray],
+                    payloads: Dict[Tuple[int, int], bytes]) -> None:
+        """Loopback fast path: merge a full [G]/[G,B] dense slice."""
+        with self._lock:
+            for vfield, dfields in KIND_FIELDS.values():
+                valid = fields[vfield]
+                cols = np.nonzero(valid)[0]
+                if len(cols) == 0:
+                    continue
+                self._arrays[vfield][src, cols] = True
+                for f in dfields:
+                    self._arrays[f][src, cols] = fields[f][cols]
+            for (g, idx), p in payloads.items():
+                self._payloads[(src, g, idx)] = p
+            self._dirty = True
+
+    def drain(self) -> Tuple[Dict[str, np.ndarray],
+                             Dict[Tuple[int, int, int], bytes]]:
+        """Take the accumulated inbox + payload staging, resetting both.
+
+        Returns the live arrays (ownership transfers to the caller) and the
+        staged payloads keyed (src, group, index)."""
+        with self._lock:
+            arrays = self._arrays
+            payloads = self._payloads
+            P, G = self.cfg.n_peers, self.cfg.n_groups
+            self._arrays = {
+                name: np.zeros((P, G) + trail, dt)
+                for name, (dt, trail) in self.template.items()
+            }
+            self._payloads = {}
+            self._dirty = False
+            return arrays, payloads
+
+    @property
+    def has_traffic(self) -> bool:
+        return self._dirty

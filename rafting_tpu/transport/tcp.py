@@ -1,0 +1,269 @@
+"""TCP transport: the host communication backend between Raft nodes.
+
+Topology mirrors the reference (transport/EventBus.java, EventNode.java):
+every node runs one listening server; every node maintains ONE persistent
+outbound connection to each peer carrying all groups' consensus traffic
+(scope-multiplexing inverted into dense tick slices, see codec.py), with
+1-second auto-reconnect (reference EventNode.java:93-94).  Snapshot bulk
+transfer uses a separate ephemeral connection per fetch so large state never
+head-of-line-blocks consensus frames (reference SnapChannel,
+transport/EventNode.java:122-267; zero-copy serve EventBus.java:98-111).
+
+Inbound connections self-identify with their first frame: HELLO = a peer's
+persistent message channel (reference handshake upgrade,
+EventBus.java:71-97); SNAP_REQ = an ephemeral snapshot fetch.
+
+Send-side queues are bounded and drop-oldest under backpressure: Raft
+tolerates loss (resend on timeout), so shedding beats unbounded buffering —
+the analog of the reference's busy-loop backpressure hint
+(support/EventLoop.java:136-138).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from . import codec
+
+log = logging.getLogger(__name__)
+
+RECONNECT_DELAY = 1.0   # seconds (reference EventNode.java:93-94)
+SEND_QUEUE_CAP = 1024
+
+
+class PeerSender:
+    """One persistent outbound channel to a peer, with reconnect."""
+
+    def __init__(self, my_id: int, peer_id: int, addr: Tuple[str, int],
+                 hello: bytes):
+        self.my_id = my_id
+        self.peer_id = peer_id
+        self.addr = addr
+        self.hello = hello
+        self.q: "queue.Queue[bytes]" = queue.Queue(SEND_QUEUE_CAP)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"raft-send-{my_id}->{peer_id}",
+            daemon=True)
+        self.connected = False
+
+    def start(self):
+        self._thread.start()
+
+    def send(self, data: Optional[bytes]) -> None:
+        if not data:  # empty tick slice: nothing to say
+            return
+        try:
+            self.q.put_nowait(data)
+        except queue.Full:
+            try:  # drop-oldest: newest consensus state supersedes stale
+                self.q.get_nowait()
+            except queue.Empty:
+                pass
+            try:
+                self.q.put_nowait(data)
+            except queue.Full:
+                pass
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def _run(self):
+        while not self._stop.is_set():
+            sock = None
+            try:
+                sock = socket.create_connection(self.addr, timeout=5)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                sock.sendall(self.hello)
+                self.connected = True
+                while not self._stop.is_set():
+                    try:
+                        data = self.q.get(timeout=0.5)
+                    except queue.Empty:
+                        continue
+                    sock.sendall(data)
+            except OSError:
+                pass
+            finally:
+                self.connected = False
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+            if not self._stop.is_set():
+                time.sleep(RECONNECT_DELAY)
+
+
+class TcpTransport:
+    """The node's network endpoint.
+
+    ``on_slice(src, fields, payloads)`` is called from reader threads with
+    each arriving tick slice (typically InboxAccumulator.merge).
+    ``snapshot_provider(group, index, term) -> (index, term, ok, bytes)``
+    serves snapshot fetches (None payload -> not available).
+    """
+
+    def __init__(self, node_id: int, peers: Dict[int, Tuple[str, int]],
+                 cfg, template,
+                 on_slice: Callable,
+                 snapshot_provider: Optional[Callable] = None):
+        self.node_id = node_id
+        self.peers = peers
+        self.cfg = cfg
+        self.template = template
+        self.on_slice = on_slice
+        self.snapshot_provider = snapshot_provider
+        self._hello = codec.pack_hello(node_id, cfg.n_groups, cfg.n_peers,
+                                       cfg.batch)
+        self._senders: Dict[int, PeerSender] = {}
+        self._server: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self._threads = []
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        host, port = self.peers[self.node_id]
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(16)
+        srv.settimeout(0.5)
+        self._server = srv
+        t = threading.Thread(target=self._accept_loop,
+                             name=f"raft-accept-{self.node_id}", daemon=True)
+        t.start()
+        self._threads.append(t)
+        for pid, addr in self.peers.items():
+            if pid == self.node_id:
+                continue
+            s = PeerSender(self.node_id, pid, addr, self._hello)
+            s.start()
+            self._senders[pid] = s
+
+    def close(self) -> None:
+        self._stop.set()
+        for s in self._senders.values():
+            s.stop()
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=5)
+
+    @property
+    def bound_port(self) -> int:
+        return self._server.getsockname()[1]
+
+    # -- sending -------------------------------------------------------------
+
+    def send_slice(self, dst: int, packed: bytes) -> None:
+        self._senders[dst].send(packed)
+
+    def fetch_snapshot(self, peer: int, group: int, index: int, term: int,
+                       timeout: float = 60.0
+                       ) -> Optional[Tuple[int, int, bytes]]:
+        """Ephemeral snapshot fetch (reference SnapChannel).  Blocking —
+        call from a worker thread.  Returns (index, term, payload) or None."""
+        try:
+            with socket.create_connection(self.peers[peer],
+                                          timeout=timeout) as sock:
+                sock.settimeout(timeout)
+                sock.sendall(codec.pack_snap_req(group, index, term))
+                reader = codec.FrameReader()
+                while True:
+                    data = sock.recv(1 << 20)
+                    if not data:
+                        return None
+                    for ftype, body in reader.feed(data):
+                        if ftype == codec.SNAP_DATA:
+                            g, idx, tm, ok, payload = \
+                                codec.unpack_snap_data(body)
+                            return (idx, tm, payload) if ok else None
+        except OSError as e:
+            log.debug("snapshot fetch from %d failed: %s", peer, e)
+            return None
+
+    # -- inbound -------------------------------------------------------------
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._read_loop, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
+
+    def _read_loop(self, conn: socket.socket):
+        reader = codec.FrameReader()
+        src: Optional[int] = None
+        conn.settimeout(1.0)
+        try:
+            while not self._stop.is_set():
+                try:
+                    data = conn.recv(1 << 20)
+                except socket.timeout:
+                    continue
+                if not data:
+                    return
+                for ftype, body in reader.feed(data):
+                    if ftype == codec.HELLO:
+                        nid, G, P, B = codec.unpack_hello(body)
+                        if (G, P, B) != (self.cfg.n_groups, self.cfg.n_peers,
+                                         self.cfg.batch):
+                            log.error("shape mismatch from node %d", nid)
+                            return
+                        src = nid
+                    elif ftype == codec.MSGS:
+                        if src is None:
+                            # No handshake yet: refuse to trust the frame's
+                            # claimed source (reference validates the channel
+                            # identity, EventBus.java:119-147).
+                            log.warning("MSGS before HELLO — connection drop")
+                            return
+                        s, fields, payloads = codec.unpack_slice(
+                            body, self.template, self.cfg.n_groups)
+                        if s != src:
+                            log.warning("frame src %d != channel src %d — "
+                                        "dropped", s, src)
+                            continue  # source spoof guard
+                        self.on_slice(s, fields, payloads)
+                    elif ftype == codec.SNAP_REQ:
+                        self._serve_snapshot(conn, body)
+                        return  # ephemeral connection: one fetch, then close
+        except (OSError, IOError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_snapshot(self, conn: socket.socket, body: bytes):
+        group, index, term = codec.unpack_snap_req(body)
+        if self.snapshot_provider is None:
+            conn.sendall(codec.pack_snap_data(group, index, term, False, b""))
+            return
+        res = self.snapshot_provider(group, index, term)
+        if res is None:
+            conn.sendall(codec.pack_snap_data(group, index, term, False, b""))
+        else:
+            idx, tm, payload = res
+            conn.sendall(codec.pack_snap_data(group, idx, tm, True, payload))

@@ -1,0 +1,166 @@
+"""Transport plane tests: codec round-trip, inbox merge semantics, real TCP
+delivery and the ephemeral snapshot channel."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from rafting_tpu.core.types import EngineConfig
+from rafting_tpu.transport import (
+    InboxAccumulator, TcpTransport, messages_template)
+from rafting_tpu.transport import codec
+
+
+CFG = EngineConfig(n_groups=8, n_peers=3, log_slots=16, batch=4, max_submit=4)
+
+
+def _dense_fields(G, B):
+    """A dense outbox slice with a couple of valid messages per kind."""
+    f = {}
+    for name, (dt, trail) in messages_template(CFG).items():
+        f[name] = np.zeros((G,) + trail, dt)
+    f["ae_valid"][2] = True
+    f["ae_term"][2] = 7
+    f["ae_prev_idx"][2] = 4
+    f["ae_prev_term"][2] = 6
+    f["ae_commit"][2] = 3
+    f["ae_n"][2] = 2
+    f["ae_ents"][2, :2] = 7
+    f["rv_valid"][5] = True
+    f["rv_term"][5] = 9
+    f["rv_prevote"][5] = True
+    f["aer_valid"][1] = True
+    f["aer_term"][1] = 7
+    f["aer_success"][1] = True
+    f["aer_match"][1] = 6
+    return f
+
+
+def test_codec_roundtrip():
+    tmpl = messages_template(CFG)
+    fields = _dense_fields(CFG.n_groups, CFG.batch)
+    payloads = {(2, 5): b"cmd-5", (2, 6): b"cmd-6"}
+    packed = codec.pack_slice(
+        1, fields, lambda g, i: payloads.get((g, i)))
+    frames = codec.FrameReader().feed(packed)
+    assert len(frames) == 1 and frames[0][0] == codec.MSGS
+    src, out, got_payloads = codec.unpack_slice(frames[0][1], tmpl)
+    assert src == 1
+    cols, vals = out["ae_term"]
+    assert cols.tolist() == [2] and vals.tolist() == [7]
+    cols, ents = out["ae_ents"]
+    assert ents.shape == (1, CFG.batch) and ents[0, :2].tolist() == [7, 7]
+    assert got_payloads == {(2, 5): b"cmd-5", (2, 6): b"cmd-6"}
+    cols, vals = out["rv_prevote"]
+    assert cols.tolist() == [5] and bool(vals[0])
+
+
+def test_codec_drops_ae_with_missing_payload():
+    """An AE column whose payload is unavailable must be dropped (loss
+    semantics), never shipped with a substitute empty command."""
+    tmpl = messages_template(CFG)
+    fields = _dense_fields(CFG.n_groups, CFG.batch)
+    packed = codec.pack_slice(1, fields, lambda g, i: None)
+    src, out, payloads = codec.unpack_slice(
+        codec.FrameReader().feed(packed)[0][1], tmpl, CFG.n_groups)
+    assert "ae_valid" not in out          # AE column dropped entirely
+    assert payloads == {}
+    assert "rv_valid" in out and "aer_valid" in out  # other kinds intact
+    # Heartbeat (n=0) AE needs no payload and must survive payload_fn=None.
+    hb = {name: np.zeros((CFG.n_groups,) + trail, dt)
+          for name, (dt, trail) in tmpl.items()}
+    hb["ae_valid"][4] = True
+    hb["ae_term"][4] = 3
+    packed = codec.pack_slice(0, hb, None)
+    _, out, _ = codec.unpack_slice(
+        codec.FrameReader().feed(packed)[0][1], tmpl, CFG.n_groups)
+    assert out["ae_term"][0].tolist() == [4]
+
+
+def test_codec_empty_slice_is_none():
+    f = {name: np.zeros((CFG.n_groups,) + trail, dt)
+         for name, (dt, trail) in messages_template(CFG).items()}
+    assert codec.pack_slice(0, f, None) is None
+
+
+def test_frame_reader_partial_and_crc():
+    body = codec.pack_hello(1, 8, 3, 4)
+    r = codec.FrameReader()
+    assert r.feed(body[:5]) == []
+    frames = r.feed(body[5:])
+    assert frames[0][0] == codec.HELLO
+    assert codec.unpack_hello(frames[0][1]) == (1, 8, 3, 4)
+    bad = bytearray(body)
+    bad[-1] ^= 0xFF
+    with pytest.raises(IOError):
+        codec.FrameReader().feed(bytes(bad))
+
+
+def test_inbox_overwrite_merge():
+    tmpl = messages_template(CFG)
+    acc = InboxAccumulator(CFG, tmpl)
+    # Two successive AE slices from src 1 for group 2: latest wins.
+    for term in (7, 8):
+        f = _dense_fields(CFG.n_groups, CFG.batch)
+        f["ae_term"][2] = term
+        packed = codec.pack_slice(1, f, lambda g, i: b"x")
+        _, body = codec.FrameReader().feed(packed)[0]
+        src, fields, payloads = codec.unpack_slice(body, tmpl)
+        acc.merge(src, fields, payloads)
+    arrays, payloads = acc.drain()
+    assert arrays["ae_valid"][1, 2] and arrays["ae_term"][1, 2] == 8
+    assert not acc.has_traffic
+    # post-drain: clean slate
+    arrays2, _ = acc.drain()
+    assert not arrays2["ae_valid"].any()
+
+
+def _free_ports(n):
+    import socket
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def test_tcp_delivery_and_snapshot():
+    p0, p1 = _free_ports(2)
+    peers = {0: ("127.0.0.1", p0), 1: ("127.0.0.1", p1)}
+
+    def provider(group, index, term):
+        return 10, 3, b"SNAPDATA" * 100
+
+    ts = {}
+    cfg2 = EngineConfig(n_groups=8, n_peers=2, log_slots=16, batch=4,
+                        max_submit=4)
+    tmpl2 = messages_template(cfg2)
+    accs = {i: InboxAccumulator(cfg2, tmpl2) for i in (0, 1)}
+    for i in (0, 1):
+        ts[i] = TcpTransport(i, dict(peers), cfg2, tmpl2,
+                             on_slice=accs[i].merge,
+                             snapshot_provider=provider)
+        ts[i].start()
+    try:
+        f = {name: np.zeros((cfg2.n_groups,) + trail, dt)
+             for name, (dt, trail) in tmpl2.items()}
+        f["rv_valid"][3] = True
+        f["rv_term"][3] = 5
+        packed = codec.pack_slice(0, f, None)
+        deadline = time.time() + 10
+        while not accs[1].has_traffic and time.time() < deadline:
+            ts[0].send_slice(1, packed)
+            time.sleep(0.05)
+        arrays, _ = accs[1].drain()
+        assert arrays["rv_valid"][0, 3] and arrays["rv_term"][0, 3] == 5
+        # snapshot side channel
+        res = ts[0].fetch_snapshot(1, group=3, index=10, term=3, timeout=10)
+        assert res == (10, 3, b"SNAPDATA" * 100)
+    finally:
+        ts[0].close()
+        ts[1].close()
