@@ -72,6 +72,10 @@ class ApplyDispatcher:
     def halt(self, g: int) -> None:
         self._halted[g] = True
 
+    def unhalt(self, g: int) -> None:
+        """Abort a halt without a recover (failed install)."""
+        self._halted[g] = False
+
     def resume_from(self, g: int, checkpoint) -> None:
         """Install a snapshot into the machine and resume applies.
 

@@ -1,0 +1,9 @@
+"""Node runtime: the host half of the framework.
+
+``RaftNode`` glues the device engine to the durable log tier, state-machine
+dispatcher, snapshot archive and transport endpoint, enforcing the
+persist-before-send durability barrier each tick."""
+
+from .node import NotLeaderError, RaftNode
+
+__all__ = ["RaftNode", "NotLeaderError"]

@@ -1,0 +1,524 @@
+"""RaftNode: one live Multi-Raft node — device engine + host runtime.
+
+The top-level runtime object, playing the role of the reference's whole
+wiring layer (RaftContainer + ContextManager + RaftRoutine + NettyCluster,
+RaftContainer.java:41-58, context/ContextManager.java:43-55): it owns the
+device-resident consensus state for ALL groups, the durable log tier, the
+state-machine dispatcher, the snapshot archive and the transport endpoint,
+and advances everything with one `tick()`.
+
+Tick protocol (the host half of the engine's contract):
+
+1. build the HostInbox: queued client submissions, finished snapshot
+   installs, compaction grants from the maintain policy;
+2. drain the transport inbox accumulator into dense device arrays;
+3. run the fused device step (`node_step`) — all groups at once;
+4. PERSIST: stage WAL writes implied by the step (appended entries with
+   payloads, truncations, (term, ballot) stable records), then ONE
+   fsync-barrier `LogStore.sync()`;
+5. only then RELEASE the outbox to peers — the reference's
+   persist-before-reply durability rule (context/member/RaftMember.java:25,
+   RocksLog flushWal after append, command/storage/RocksLog.java:87,195)
+   amortized over every group in one barrier;
+6. drive state-machine applies from the new commit frontier;
+7. run the snapshot/compaction maintain policy and snapshot downloads.
+
+Payload flow: a leader's payloads enter via `submit()`; a follower's arrive
+staged with AppendEntries frames and are durably adopted only for the range
+the device engine actually accepted (StepInfo.appended_from/to).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.step import node_step
+from ..core.types import (
+    LEADER, NIL, EngineConfig, HostInbox, Messages, StepInfo, init_state,
+)
+from ..log.store import LogStore, restore_raft_state
+from ..machine.dispatch import ApplyDispatcher
+from ..machine.spi import Checkpoint, MachineProvider
+from ..snapshot.archive import SnapshotArchive
+from ..snapshot.policy import MaintainAgreement
+from ..transport import InboxAccumulator, messages_template
+from ..transport.codec import pack_slice
+
+log = logging.getLogger(__name__)
+
+
+class NotLeaderError(Exception):
+    """Submission refused: this node does not lead the group.  Carries the
+    last known leader for client redirect (reference NotLeaderException,
+    support/anomaly/NotLeaderException.java:11-27)."""
+
+    def __init__(self, group: int, leader: Optional[int]):
+        super().__init__(f"group {group}: not leader "
+                         f"(hint: {leader if leader is not None else '?'})")
+        self.group = group
+        self.leader = leader
+
+
+class RaftNode:
+    def __init__(self, cfg: EngineConfig, node_id: int, data_dir: str,
+                 provider: MachineProvider,
+                 transport_factory: Callable,
+                 seed: int = 0,
+                 maintain: Optional[MaintainAgreement] = None):
+        """``transport_factory(node, on_slice, snapshot_provider)`` builds
+        the transport endpoint (TcpTransport / LoopbackTransport)."""
+        self.cfg = cfg
+        self.node_id = node_id
+        self.data_dir = data_dir
+        os.makedirs(data_dir, exist_ok=True)
+
+        self.store = LogStore(os.path.join(data_dir, "wal"))
+        self.archive = SnapshotArchive(os.path.join(data_dir, "snapshots"))
+        self.dispatcher = ApplyDispatcher(provider, self._payload)
+        self.maintain = maintain or MaintainAgreement(cfg.n_groups)
+        self.template = messages_template(cfg)
+        self.acc = InboxAccumulator(cfg, self.template)
+        self.transport = transport_factory(self, self.acc.merge,
+                                           self._serve_snapshot)
+
+        # Crash recovery: device state from the WAL (reference
+        # RaftContext.initialize restore order, context/RaftContext.java:
+        # 91-113), machines from their newest archived snapshot.
+        self.state = restore_raft_state(cfg, node_id, self.store, seed=seed)
+        self._recover_machines()
+
+        # Host mirrors of per-group device lanes (refreshed each tick).
+        G = cfg.n_groups
+        self.h_role = np.zeros(G, np.int32)
+        self.h_leader = np.full(G, NIL, np.int32)
+        self.h_term = np.asarray(self.state.term).copy()
+        self.h_voted = np.asarray(self.state.voted_for).copy()
+        self.h_commit = np.asarray(self.state.commit).copy()
+        self.h_base = np.asarray(self.state.log.base).copy()
+
+        # Client submissions: group -> FIFO of (payload, Future).
+        self._submit_lock = threading.Lock()
+        self._submissions: Dict[int, List[Tuple[bytes, Future]]] = {}
+
+        # Snapshot downloads: worker threads ONLY fetch bytes to a temp file;
+        # every store/dispatcher/archive mutation happens on the tick thread
+        # (single-writer discipline — the analog of the reference's
+        # per-group event-loop rule, context/member/RaftMember.java:31-35).
+        self._snap_lock = threading.Lock()
+        self._snap_fetched: List[Tuple[int, int, int, str]] = []
+        self._snap_inflight: set = set()
+        self._snap_threads: List[threading.Thread] = []
+
+        # Compaction grants computed at the end of tick t, applied in t+1.
+        self._compact_grant = np.zeros(G, np.int64)
+
+        self.ticks = 0
+        self.metrics = {"commits": 0, "applies": 0, "elections": 0,
+                        "snapshots_taken": 0, "snapshots_installed": 0}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------ API
+
+    def start(self, tick_interval: float = 0.02) -> None:
+        """Run the tick loop in a background thread (the node's
+        'event loop'; interval plays the reference's tick,
+        support/RaftConfig.java:171-185)."""
+        self.transport.start()
+        self._thread = threading.Thread(
+            target=self._run, args=(tick_interval,),
+            name=f"raft-node-{self.node_id}", daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self.transport.close()
+        # In-flight snapshot workers touch the store; they must finish (or
+        # observe _stop) before the native WAL handle is released.
+        for t in self._snap_threads:
+            t.join(timeout=10)
+        self.dispatcher.close()
+        self.store.close()
+
+    def submit(self, group: int, payload: bytes) -> Future:
+        """Offer a command to the group's replicated log.  The returned
+        future completes with the machine's apply result (reference
+        RaftStub.submit -> Promise, command/RaftStub.java:65-74)."""
+        fut: Future = Future()
+        if self.h_role[group] != LEADER:
+            hint = int(self.h_leader[group])
+            fut.set_exception(NotLeaderError(
+                group, None if hint == NIL else hint))
+            return fut
+        with self._submit_lock:
+            self._submissions.setdefault(group, []).append((payload, fut))
+        return fut
+
+    def is_leader(self, group: int) -> bool:
+        return bool(self.h_role[group] == LEADER)
+
+    def leader_hint(self, group: int) -> Optional[int]:
+        h = int(self.h_leader[group])
+        return None if h == NIL else h
+
+    # ------------------------------------------------------------- tick loop
+
+    def _run(self, interval: float) -> None:
+        while not self._stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                self.tick()
+            except Exception:
+                log.exception("node %d tick failed", self.node_id)
+            dt = time.perf_counter() - t0
+            if dt < interval:
+                time.sleep(interval - dt)
+
+    def tick(self) -> StepInfo:
+        cfg = self.cfg
+        G, P = cfg.n_groups, cfg.n_peers
+
+        # -- 1. host inbox ---------------------------------------------------
+        submit_n = np.zeros(G, np.int32)
+        with self._submit_lock:
+            for g, q in self._submissions.items():
+                submit_n[g] = min(len(q), cfg.max_submit)
+        snap_done = np.zeros(G, bool)
+        snap_idx = np.zeros(G, np.int32)
+        snap_term = np.zeros(G, np.int32)
+        with self._snap_lock:
+            fetched, self._snap_fetched = self._snap_fetched, []
+        for g, idx, term in self._install_snapshots(fetched):
+            snap_done[g] = True
+            snap_idx[g] = idx
+            snap_term[g] = term
+        host = HostInbox(
+            submit_n=jnp.asarray(submit_n),
+            snap_done=jnp.asarray(snap_done),
+            snap_idx=jnp.asarray(snap_idx),
+            snap_term=jnp.asarray(snap_term),
+            compact_to=jnp.asarray(self._compact_grant.astype(np.int32)),
+        )
+        self._compact_grant = np.zeros(G, np.int64)
+
+        # -- 2. network inbox ------------------------------------------------
+        arrays, staged_payloads = self.acc.drain()
+        inbox = Messages(**{k: jnp.asarray(v) for k, v in arrays.items()})
+
+        # -- 3. device step --------------------------------------------------
+        self.state, outbox, info = node_step(cfg, self.state, inbox, host)
+
+        # One transfer for everything the host needs this tick.
+        (h_info, h_out, h_term, h_voted, h_role, h_leader, h_commit, h_base,
+         h_base_term) = jax.device_get(
+            (info, outbox, self.state.term, self.state.voted_for,
+             self.state.role, self.state.leader_id, self.state.commit,
+             self.state.log.base, self.state.log.base_term))
+
+        old_role = self.h_role
+        self.h_role, self.h_leader = h_role, h_leader
+        self.h_commit, self.h_base = h_commit, h_base
+        self.metrics["elections"] += int(
+            ((h_role == LEADER) & (old_role != LEADER)).sum())
+        # Leadership lost: abort outstanding client promises BEFORE any
+        # apply could complete them with a different command's result at
+        # the same index (reference abortPromise on role change,
+        # context/RaftContext.java:165-187).  The command may still commit
+        # cluster-wide — NotLeader tells the client to re-check, the
+        # standard Raft client contract.
+        for g in np.nonzero((old_role == LEADER) & (h_role != LEADER))[0]:
+            g = int(g)
+            self.dispatcher.abort_promises(
+                g, NotLeaderError(g, self.leader_hint(g)))
+            self._reject_submissions(g)
+
+        # -- 4. persistence barrier ------------------------------------------
+        self._persist(h_info, h_term, h_voted, h_leader, h_base, h_base_term,
+                      staged_payloads, arrays, submit_n)
+
+        # -- 5. release outbox ----------------------------------------------
+        self._send(h_out)
+
+        # -- 6. applies ------------------------------------------------------
+        before = self.dispatcher.applied_frontier(G)
+        self.dispatcher.advance(h_commit)
+        after = self.dispatcher.applied_frontier(G)
+        self.metrics["applies"] += int((after - before).sum())
+        self.metrics["commits"] = int(h_commit.astype(np.int64).sum())
+
+        # -- 7. maintain: checkpoints, compaction, snapshot downloads --------
+        self._maintain(after, h_base, h_term)
+        self._snapshot_requests(h_info, h_base)
+
+        self.ticks += 1
+        return h_info
+
+    # ---------------------------------------------------------- persistence
+
+    def _persist(self, info: StepInfo, h_term, h_voted, h_leader,
+                 h_base, h_base_term, staged_payloads, inbox_arrays,
+                 submit_n) -> None:
+        dirty = np.nonzero(np.asarray(info.dirty))[0]
+        app_from = np.asarray(info.appended_from)
+        app_to = np.asarray(info.appended_to)
+        log_tail = np.asarray(info.log_tail)
+        sub_start = np.asarray(info.submit_start)
+        sub_acc = np.asarray(info.submit_acc)
+        any_write = False
+
+        for g in dirty.tolist():
+            # (term, ballot) durable before any reply leaves (reference
+            # RaftMember ctor persists first, context/member/RaftMember.java:25)
+            self.store.put_stable(g, int(h_term[g]), int(h_voted[g]))
+            any_write = True
+
+        # Entries appended/overwritten this tick.
+        wrote = np.nonzero(app_to > 0)[0]
+        for g in wrote.tolist():
+            lo, hi = int(app_from[g]), int(app_to[g])
+            n_sub = int(sub_acc[g])
+            sub_lo = int(sub_start[g])
+            leader_src = int(h_leader[g])
+            terms, payloads, idxs = [], [], []
+            for idx in range(lo, hi + 1):
+                if n_sub and idx >= sub_lo:
+                    # our own accepted submission: payload from the queue
+                    k = idx - sub_lo
+                    payload = self._take_submission(g, k)
+                    term = int(h_term[g])
+                else:
+                    # follower adoption: payload staged with the leader's
+                    # frame; term from the same frame's entry-term vector
+                    # (the message the engine just accepted).
+                    payload = staged_payloads.get((leader_src, g, idx))
+                    term = self._staged_term(inbox_arrays, leader_src, g, idx)
+                    if payload is None or term is None:
+                        # Entry accepted on device but its bytes are not
+                        # locally available (e.g. duplicate-delivery edge).
+                        # Stop at the gap: the durable prefix stays
+                        # contiguous; resend will re-deliver.
+                        break
+                idxs.append(idx)
+                terms.append(term)
+                payloads.append(payload)
+            if idxs:
+                self.store.append_entries(g, idxs[0], terms, payloads)
+                any_write = True
+            self._commit_submissions(g, sub_lo, n_sub)
+
+        # Truncations: durable tail must not exceed the device tail.
+        for g in dirty.tolist():
+            self.store.truncate_to(g, int(log_tail[g]))
+
+        # WAL floor follows the device compaction floor.
+        wal_floors_moved = False
+        for g in np.nonzero(h_base > 0)[0].tolist():
+            if int(h_base[g]) > self.store.floor(g):
+                self.store.set_floor(g, int(h_base[g]), int(h_base_term[g]))
+                wal_floors_moved = True
+
+        if any_write or wal_floors_moved:
+            self.store.sync()   # THE durability barrier
+
+        # Submissions offered but refused because we are no longer leader:
+        # fail fast with a redirect hint.  A still-leading group whose ring
+        # is briefly full keeps its queue (backpressure, not rejection —
+        # the reference distinguishes BusyLoop from NotLeader,
+        # support/anomaly/).
+        rejected = np.nonzero((submit_n > 0) & (sub_acc < submit_n)
+                              & (self.h_role != LEADER))[0]
+        for g in rejected.tolist():
+            self._reject_submissions(int(g))
+
+    def _take_submission(self, g: int, k: int) -> bytes:
+        with self._submit_lock:
+            return self._submissions[g][k][0]
+
+    def _commit_submissions(self, g: int, start_idx: int, n: int) -> None:
+        """Register promises for accepted commands and drop them from the
+        queue (reference: promise map keyed by EntryKey,
+        context/RaftContext.java:223-237)."""
+        if n == 0:
+            return
+        with self._submit_lock:
+            q = self._submissions.get(g, [])
+            taken, self._submissions[g] = q[:n], q[n:]
+        for k, (_, fut) in enumerate(taken):
+            self.dispatcher.register_promise(g, start_idx + k, fut)
+
+    def _reject_submissions(self, g: int) -> None:
+        with self._submit_lock:
+            q = self._submissions.get(g, [])
+            self._submissions[g] = []
+        hint = self.leader_hint(g)
+        for payload, fut in q:
+            if not fut.done():
+                fut.set_exception(NotLeaderError(g, hint))
+
+    @staticmethod
+    def _staged_term(arrays, src: int, g: int, idx: int) -> Optional[int]:
+        """Term of a follower-adopted entry, from the AppendEntries frame the
+        engine just accepted (host-side; no device read)."""
+        if src < 0 or not arrays:
+            return None
+        if not arrays["ae_valid"][src, g]:
+            return None
+        k = idx - int(arrays["ae_prev_idx"][src, g]) - 1
+        if 0 <= k < int(arrays["ae_n"][src, g]):
+            return int(arrays["ae_ents"][src, g, k])
+        return None
+
+    def _payload(self, g: int, idx: int) -> Optional[bytes]:
+        return self.store.payload(g, idx)
+
+    # ------------------------------------------------------------------ send
+
+    def _send(self, h_out) -> None:
+        P = self.cfg.n_peers
+        fields_all = {name: np.asarray(getattr(h_out, name))
+                      for name in self.template}
+        for p in range(P):
+            if p == self.node_id:
+                continue
+            fields = {name: arr[p] for name, arr in fields_all.items()}
+            packed = pack_slice(self.node_id, fields, self._payload)
+            if packed is not None:
+                self.transport.send_slice(p, packed)
+
+    # -------------------------------------------------------------- maintain
+
+    def _maintain(self, applied: np.ndarray, h_base, h_term) -> None:
+        now = self.ticks
+        need = self.maintain.need_checkpoint(now, applied, h_base)
+        for g in np.nonzero(need)[0].tolist():
+            try:
+                ckpt = self.dispatcher.machine(g).checkpoint(0)
+            except Exception:
+                log.exception("checkpoint failed g=%d", g)
+                continue
+            # Snapshot term = term of the log entry at the checkpoint index.
+            t = self.store.entry_term(g, ckpt.index)
+            if t < 0:
+                t = self.store.floor_term(g)
+            self.archive.save_checkpoint(g, ckpt.path, ckpt.index, t)
+            self.maintain.note_checkpoint(g, now, ckpt.index)
+            self.metrics["snapshots_taken"] += 1
+            try:
+                os.unlink(ckpt.path)
+            except OSError:
+                pass
+        self._compact_grant = self.maintain.compact_targets(
+            now, self.h_commit.astype(np.int64), h_base.astype(np.int64))
+
+    # -------------------------------------------------------------- snapshot
+
+    def _serve_snapshot(self, group: int, index: int, term: int
+                        ) -> Optional[Tuple[int, int, bytes]]:
+        """Transport callback: serve our newest snapshot for the group
+        (reference EventBus WaitSnap -> TransSnap + sendfile,
+        transport/EventBus.java:98-111)."""
+        snap = self.archive.last_snapshot(group)
+        if snap is None:
+            return None
+        try:
+            with open(snap.path, "rb") as f:
+                return snap.index, snap.term, f.read()
+        except OSError:
+            return None
+
+    def _snapshot_requests(self, info: StepInfo, h_base) -> None:
+        req = np.nonzero(np.asarray(info.snap_req))[0]
+        for g in req.tolist():
+            g = int(g)
+            if g in self._snap_inflight:
+                continue
+            idx = int(np.asarray(info.snap_req_idx)[g])
+            term = int(np.asarray(info.snap_req_term)[g])
+            peer = int(np.asarray(info.snap_req_from)[g])
+            if self.archive.pend_snapshot(g, idx, term, peer) is None:
+                continue
+            self._snap_inflight.add(g)
+            t = threading.Thread(
+                target=self._download_snapshot, args=(g, peer, idx, term),
+                name=f"raft-snapfetch-{self.node_id}-g{g}", daemon=True)
+            t.start()
+            self._snap_threads = [x for x in self._snap_threads
+                                  if x.is_alive()]
+            self._snap_threads.append(t)
+
+    def _download_snapshot(self, g: int, peer: int, idx: int,
+                           term: int) -> None:
+        """Worker: fetch ONE snapshot's bytes to a temp file (reference
+        SnapChannel download, transport/EventNode.java:122-267).  Install —
+        every store/dispatcher/archive mutation — happens on the tick
+        thread in ``_install_snapshots``."""
+        try:
+            res = self.transport.fetch_snapshot(peer, g, idx, term)
+            if res is None or self._stop.is_set():
+                self.archive.fail_pending(g)
+                return
+            got_idx, got_term, payload = res
+            tmp = os.path.join(self.data_dir, f"snap-recv-g{g}.tmp")
+            with open(tmp, "wb") as f:
+                f.write(payload)
+            with self._snap_lock:
+                self._snap_fetched.append((g, got_idx, got_term, tmp))
+        except Exception:
+            log.exception("snapshot fetch failed g=%d", g)
+            self.archive.fail_pending(g)
+        finally:
+            self._snap_inflight.discard(g)
+
+    def _install_snapshots(self, fetched) -> List[Tuple[int, int, int]]:
+        """Tick thread: install downloaded snapshots (reference
+        restoreCheckpoint, context/RaftRoutine.java:482-541).  Applies and
+        installs run on the same thread, so the reference's halt-the-apply-
+        pool dance is unnecessary by construction."""
+        done = []
+        for g, got_idx, got_term, tmp in fetched:
+            try:
+                snap = self.archive.install_pending(g, tmp, got_idx, got_term)
+                self.dispatcher.resume_from(
+                    g, Checkpoint(path=snap.path, index=snap.index))
+                # Durable milestone before the device adopts it (the stable-
+                # record rule for snapshots, support/StableLock.java:82-91).
+                self.store.set_floor(g, snap.index, snap.term)
+                self.store.sync()
+                self.maintain.note_checkpoint(g, self.ticks, snap.index)
+                self.metrics["snapshots_installed"] += 1
+                done.append((g, snap.index, snap.term))
+            except Exception:
+                log.exception("snapshot install failed g=%d", g)
+                self.archive.clear_pending(g)
+            finally:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+        return done
+
+    # -------------------------------------------------------------- recovery
+
+    def _recover_machines(self) -> None:
+        """Boot-time machine catch-up: if a machine lags the newest archived
+        snapshot (or the WAL floor — entries below it are gone), recover it
+        from the snapshot before applies start (reference bootstrap replay,
+        command/admin/Administrator.java:44-57 analog)."""
+        for g in range(self.cfg.n_groups):
+            snap = self.archive.last_snapshot(g)
+            if snap is None:
+                continue
+            m = self.dispatcher.machine(g)
+            if m.last_applied() < snap.index:
+                m.recover(Checkpoint(path=snap.path, index=snap.index))
