@@ -15,3 +15,10 @@ from .core import (  # noqa: F401
     DeviceCluster, EngineConfig, HostInbox, Messages, RaftState, StepInfo,
     cluster_step, init_state, node_step,
 )
+from .api import (  # noqa: F401
+    ADMIN_GROUP, BusyLoopError, NotLeaderError, NotReadyError,
+    ObsoleteContextError, RaftConfig, RaftContainer, RaftError, RaftFactory,
+    RaftStub, RetryCommandError, SerializeError, WaitTimeoutError,
+    load_xml_config,
+)
+from .runtime import RaftNode  # noqa: F401

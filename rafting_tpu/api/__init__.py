@@ -1,0 +1,20 @@
+"""Public API layer: container / stub / factory / config + error taxonomy
+(the reference's L5, RaftContainer.java / command/RaftStub.java /
+support/RaftFactory.java / support/RaftConfig.java)."""
+
+from .anomaly import (
+    BusyLoopError, NotLeaderError, NotReadyError, ObsoleteContextError,
+    RaftError, RetryCommandError, SerializeError, WaitTimeoutError,
+)
+from .config import RaftConfig, load_xml_config
+from .container import ADMIN_GROUP, GroupRegistry, RaftContainer
+from .factory import RaftFactory
+from .stub import RaftStub
+
+__all__ = [
+    "RaftConfig", "load_xml_config", "RaftContainer", "RaftFactory",
+    "RaftStub", "GroupRegistry", "ADMIN_GROUP",
+    "RaftError", "NotLeaderError", "NotReadyError", "BusyLoopError",
+    "ObsoleteContextError", "WaitTimeoutError", "RetryCommandError",
+    "SerializeError",
+]

@@ -1,0 +1,63 @@
+"""Exception taxonomy — the user-visible failure vocabulary.
+
+Mirrors the reference's stackless anomaly set (support/anomaly/*.java,
+support/RaftException.java:13-16): each condition a client can observe has
+a distinct type so callers can route on it (redirect, back off, retry,
+give up).  Python tracebacks are cheap, so these are ordinary exceptions;
+the *taxonomy* is what's preserved.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class RaftError(Exception):
+    """Base for all framework errors (reference RaftException)."""
+
+
+class NotLeaderError(RaftError):
+    """Submission refused: this node does not lead the group.  Carries the
+    last known leader for client redirect (reference NotLeaderException,
+    support/anomaly/NotLeaderException.java:11-27)."""
+
+    def __init__(self, group, leader: Optional[int] = None):
+        super().__init__(f"group {group}: not leader "
+                         f"(hint: {leader if leader is not None else '?'})")
+        self.group = group
+        self.leader = leader
+
+
+class NotReadyError(RaftError):
+    """Leader exists but a majority of followers are unhealthy; refuse new
+    commands rather than buffer unboundedly (reference NotReadyException +
+    Leader.isReady quorum-health gate, context/member/Leader.java:52-64)."""
+
+
+class BusyLoopError(RaftError):
+    """Backpressure: the node's submission queue for the group is full
+    (reference BusyLoopException, support/EventLoop.java:136-138)."""
+
+
+class ObsoleteContextError(RaftError):
+    """The group was closed or destroyed (reference
+    ObsoleteContextException; Administrator lifecycle,
+    command/admin/Administrator.java:123-154)."""
+
+
+class WaitTimeoutError(RaftError):
+    """A client wait elapsed before the command committed (reference
+    WaitTimeoutException, support/Promise.java:23-32)."""
+
+
+class RetryCommandError(RaftError):
+    """A state machine asked for the apply to be retried later (reference
+    RetryCommandException, support/anomaly/RetryCommandException.java:10-25)."""
+
+    def __init__(self, delay_s: float = 0.05):
+        super().__init__(f"retry after {delay_s}s")
+        self.delay_s = delay_s
+
+
+class SerializeError(RaftError):
+    """Command (de)serialization failed (reference SerializeException)."""

@@ -1,0 +1,59 @@
+"""RaftFactory: the pluggable wiring SPI.
+
+The reference's abstract factory (support/RaftFactory.java:16-38) lets a
+user swap the log store, state machine, context manager and cluster while
+``bootstrap`` wires the products together.  Here the products are the
+machine provider, the transport backend and the maintain policy; the
+container calls ``build_node`` to assemble a RaftNode from them
+(bootstrap analog, RaftFactory.java:30-34).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..machine.file_machine import FileMachineProvider
+from ..machine.spi import MachineProvider
+from ..runtime.node import RaftNode
+from ..transport import TcpTransport
+from .config import RaftConfig
+
+
+class RaftFactory:
+    """Default factory: FileMachine state machines + TCP transport.
+    Subclass and override ``machine_provider`` (the reference's abstract
+    ``restartMachine``, RaftFactory.java:36) or ``transport_factory``."""
+
+    def machine_provider(self, config: RaftConfig,
+                         node_id: int) -> MachineProvider:
+        return FileMachineProvider(
+            os.path.join(config.data_dir, "machines"))
+
+    def transport_factory(self, config: RaftConfig) -> Callable:
+        peers = dict(enumerate(config.node_addresses()))
+
+        def build(node, on_slice, snapshot_provider):
+            return TcpTransport(node.node_id, peers, node.cfg,
+                                node.template, on_slice, snapshot_provider,
+                                submit_handler=node.submit)
+        return build
+
+    def maintain(self, config: RaftConfig):
+        return config.maintain()
+
+    def build_node(self, config: RaftConfig,
+                   initial_active: Optional[np.ndarray] = None,
+                   provider_override: Optional[MachineProvider] = None
+                   ) -> RaftNode:
+        node_id = config.node_id
+        return RaftNode(
+            config.engine_config(), node_id, config.data_dir,
+            provider_override or self.machine_provider(config, node_id),
+            self.transport_factory(config),
+            seed=config.seed,
+            maintain=self.maintain(config),
+            initial_active=initial_active,
+        )

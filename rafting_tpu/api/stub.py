@@ -1,0 +1,116 @@
+"""RaftStub: the user-facing client handle for one group.
+
+Submit a command, get a future (or block with ``execute``); rejected with a
+redirect hint when this node isn't the leader.  Handles are refcounted by
+the container so closing the last one releases the cache slot (reference
+command/RaftStub.java:47-110, RaftContainer.getStub:92-111)."""
+
+from __future__ import annotations
+
+import json
+import threading
+from concurrent.futures import Future, TimeoutError as _FutTimeout
+from typing import Any, Optional, Union
+
+from .anomaly import (
+    NotLeaderError, ObsoleteContextError, RaftError, WaitTimeoutError,
+)
+
+
+def _encode(command: Union[bytes, str]) -> bytes:
+    return command.encode("utf-8") if isinstance(command, str) else command
+
+
+class RaftStub:
+    def __init__(self, container, name: str, lane: int, forward: bool = True):
+        """``forward=True`` relays submissions to the current leader over
+        the transport when this node is a follower, instead of bouncing
+        NotLeader back to the caller (the reference only returns the hint,
+        support/anomaly/NotLeaderException.java:11-27; forwarded results
+        must be JSON-serializable)."""
+        self._container = container
+        self.name = name
+        self.lane = lane
+        self.forward = forward
+        self._closed = False
+
+    def submit(self, command: Union[bytes, str]) -> Future:
+        """Async submit (reference RaftStub.submit -> Promise,
+        command/RaftStub.java:65-74).  The future resolves with the state
+        machine's apply result, or NotLeaderError with a redirect hint.
+
+        At-most-once per call: if a LOCAL submit is accepted and later
+        aborted by a leadership change, it is NOT auto-forwarded — the
+        command may still commit under the new leader, and resubmitting
+        would double-apply it.  Only submissions that never entered the
+        local log are forwarded."""
+        if self._closed:
+            raise ObsoleteContextError(f"stub for {self.name!r} closed")
+        payload = _encode(command)
+        node = self._container._node
+        if node.is_leader(self.lane) or not self.forward:
+            fut = node.submit(self.lane, payload)
+            # A synchronous fast-fail (leadership moved between our check
+            # and the node's) never entered the log: forwarding is safe.
+            if self.forward and fut.done() and \
+                    isinstance(fut.exception(), NotLeaderError):
+                return self._forwarded(payload)
+            return fut
+        return self._forwarded(payload)
+
+    def _forwarded(self, payload: bytes) -> Future:
+        """Relay to the leader from a worker thread (the forward channel is
+        a blocking ephemeral connection)."""
+        node = self._container._node
+        out: Future = Future()
+
+        def run():
+            try:
+                hint = node.leader_hint(self.lane)
+                if hint is None:
+                    raise NotLeaderError(self.lane, None)
+                ok, raw = node.transport.forward_submit(
+                    hint, self.lane, payload, timeout=30)
+                if not ok:
+                    raise RaftError(
+                        f"forward failed: {raw.decode(errors='replace')}")
+                out.set_result(json.loads(raw))
+            except Exception as e:
+                if not out.done():
+                    out.set_exception(e)
+        threading.Thread(target=run, daemon=True,
+                         name=f"raft-fwd-{self.name}").start()
+        return out
+
+
+    def execute(self, command: Union[bytes, str],
+                timeout: Optional[float] = None) -> Any:
+        """Blocking submit (reference RaftStub.execute,
+        command/RaftStub.java:47-58)."""
+        fut = self.submit(command)
+        try:
+            return fut.result(timeout=timeout)
+        except _FutTimeout:
+            raise WaitTimeoutError(
+                f"command on {self.name!r} not committed in {timeout}s")
+
+    @property
+    def leader_hint(self) -> Optional[int]:
+        return self._container._node.leader_hint(self.lane)
+
+    def is_leader(self) -> bool:
+        return self._container._node.is_leader(self.lane)
+
+    def close(self) -> None:
+        """Release one reference; the shared handle only goes dead when the
+        LAST holder closes (refcount semantics, reference getStub:92-111)."""
+        if not self._closed:
+            remaining = self._container._release_stub(self.name)
+            if remaining == 0:
+                self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

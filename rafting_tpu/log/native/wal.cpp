@@ -50,6 +50,7 @@ constexpr uint8_t kEntry = 1;
 constexpr uint8_t kStable = 2;
 constexpr uint8_t kTruncate = 3;
 constexpr uint8_t kMilestone = 4;
+constexpr uint8_t kReset = 5;  // group destroyed: forget ALL its state
 
 // CRC-32 (IEEE), small table-driven implementation.
 uint32_t crc_table[256];
@@ -205,6 +206,12 @@ bool apply_body(Wal& w, const uint8_t* b, uint32_t len, uint32_t seg,
       }
       return true;
     }
+    case kReset: {
+      if (len != 1 + 4) return false;
+      uint32_t g = get_u32(b + 1);
+      w.groups.erase(g);  // a later open of this lane starts from scratch
+      return true;
+    }
     default:
       return false;
   }
@@ -356,6 +363,20 @@ void wal_milestone(void* h, uint32_t group, uint64_t index, int64_t term) {
     gs.drop_prefix(index);
     if (gs.tail < gs.floor) gs.tail = gs.floor;
   }
+  frame(w->buf, body);
+  maybe_rotate(*w);
+}
+
+// Group destroyed (admin lifecycle): journal a RESET so the lane's entire
+// durable state — entries, stable record, milestone — is forgotten, letting
+// a future group reuse the lane from scratch (the reference deletes the
+// group's RocksDB directory, command/storage/RocksStateLoader.java:48-59).
+void wal_reset(void* h, uint32_t group) {
+  Wal* w = (Wal*)h;
+  std::vector<uint8_t> body;
+  body.push_back(kReset);
+  put_u32(body, group);
+  w->groups.erase(group);
   frame(w->buf, body);
   maybe_rotate(*w);
 }

@@ -78,6 +78,17 @@ class LogStore:
             del self._cache[key]
         self._durable_tail[g] = max(self._durable_tail.get(g, 0), index)
 
+    def reset_group(self, g: int) -> None:
+        """Forget a destroyed group's entire durable state (entries, stable
+        record, milestone) so a future group can reuse the lane from
+        scratch (the reference deletes the group's RocksDB dir,
+        command/storage/RocksStateLoader.java:48-59)."""
+        self.wal.reset(g)
+        for key in [k for k in self._cache if k[0] == g]:
+            del self._cache[key]
+        self._stable.pop(g, None)
+        self._durable_tail.pop(g, None)
+
     def sync(self) -> None:
         """The durability barrier: one fsync covering all staged writes."""
         self.wal.sync()

@@ -64,6 +64,7 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64]
         lib.wal_milestone.argtypes = [
             ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64, ctypes.c_int64]
+        lib.wal_reset.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
         lib.wal_sync.argtypes = [ctypes.c_void_p]
         lib.wal_sync.restype = ctypes.c_int
         for f, res in [("wal_tail", ctypes.c_int64),
@@ -121,6 +122,9 @@ class _NativeWal:
     def milestone(self, g, idx, term):
         self._lib.wal_milestone(self._h, g, idx, term)
 
+    def reset(self, g):
+        self._lib.wal_reset(self._h, g)
+
     def sync(self):
         if self._lib.wal_sync(self._h) != 0:
             raise IOError("wal_sync failed")
@@ -163,7 +167,7 @@ class _NativeWal:
 
 
 _MAGIC = 0x52574131
-_ENTRY, _STABLE, _TRUNCATE, _MILESTONE = 1, 2, 3, 4
+_ENTRY, _STABLE, _TRUNCATE, _MILESTONE, _RESET = 1, 2, 3, 4, 5
 
 
 class _PyGroup:
@@ -247,6 +251,9 @@ class PyWal:
                 gs.floor, gs.floor_term = idx, _signed(term)
                 gs.drop_prefix(idx)
                 gs.tail = max(gs.tail, gs.floor)
+        elif t == _RESET:
+            (g,) = struct.unpack_from("<I", body, 1)
+            self.groups.pop(g, None)
 
     def _emit(self, body: bytes):
         self._buf += struct.pack("<III", _MAGIC, len(body), zlib.crc32(body))
@@ -288,6 +295,11 @@ class PyWal:
             gs.drop_prefix(idx)
             gs.tail = max(gs.tail, gs.floor)
         self._emit(struct.pack("<BIQQ", _MILESTONE, g, idx, term & M64))
+
+    def reset(self, g):
+        """Group destroyed: forget the lane's entire durable state."""
+        self.groups.pop(g, None)
+        self._emit(struct.pack("<BI", _RESET, g))
 
     def sync(self):
         self._flush()

@@ -76,6 +76,16 @@ class ApplyDispatcher:
         """Abort a halt without a recover (failed install)."""
         self._halted[g] = False
 
+    def drop_machine(self, g: int, destroy: bool = False) -> None:
+        """Forget a group's machine (group closed/destroyed; reference
+        destroyContext, context/ContextManager.java:139-167)."""
+        m = self._machines.pop(g, None)
+        if m is not None:
+            (m.destroy if destroy else m.close)()
+        self._halted.pop(g, None)
+        for key in [k for k in self._retry_counts if k[0] == g]:
+            del self._retry_counts[key]
+
     def resume_from(self, g: int, checkpoint) -> None:
         """Install a snapshot into the machine and resume applies.
 

@@ -4,6 +4,7 @@
 dispatcher, snapshot archive and transport endpoint, enforcing the
 persist-before-send durability barrier each tick."""
 
-from .node import NotLeaderError, RaftNode
+from ..api.anomaly import NotLeaderError
+from .node import RaftNode
 
 __all__ = ["RaftNode", "NotLeaderError"]

@@ -43,7 +43,7 @@ import numpy as np
 
 from ..core.step import node_step
 from ..core.types import (
-    LEADER, NIL, EngineConfig, HostInbox, Messages, StepInfo, init_state,
+    I32, LEADER, NIL, EngineConfig, HostInbox, Messages, StepInfo, init_state,
 )
 from ..log.store import LogStore, restore_raft_state
 from ..machine.dispatch import ApplyDispatcher
@@ -52,20 +52,9 @@ from ..snapshot.archive import SnapshotArchive
 from ..snapshot.policy import MaintainAgreement
 from ..transport import InboxAccumulator, messages_template
 from ..transport.codec import pack_slice
+from ..api.anomaly import NotLeaderError, ObsoleteContextError
 
 log = logging.getLogger(__name__)
-
-
-class NotLeaderError(Exception):
-    """Submission refused: this node does not lead the group.  Carries the
-    last known leader for client redirect (reference NotLeaderException,
-    support/anomaly/NotLeaderException.java:11-27)."""
-
-    def __init__(self, group: int, leader: Optional[int]):
-        super().__init__(f"group {group}: not leader "
-                         f"(hint: {leader if leader is not None else '?'})")
-        self.group = group
-        self.leader = leader
 
 
 class RaftNode:
@@ -73,9 +62,14 @@ class RaftNode:
                  provider: MachineProvider,
                  transport_factory: Callable,
                  seed: int = 0,
-                 maintain: Optional[MaintainAgreement] = None):
+                 maintain: Optional[MaintainAgreement] = None,
+                 initial_active: Optional[np.ndarray] = None):
         """``transport_factory(node, on_slice, snapshot_provider)`` builds
-        the transport endpoint (TcpTransport / LoopbackTransport)."""
+        the transport endpoint (TcpTransport / LoopbackTransport).
+        ``initial_active`` masks which group lanes start open (default all;
+        the container passes the admin-group view so closed groups stay
+        inert, reference Administrator restart re-creation,
+        command/admin/Administrator.java:50-57)."""
         self.cfg = cfg
         self.node_id = node_id
         self.data_dir = data_dir
@@ -94,7 +88,17 @@ class RaftNode:
         # RaftContext.initialize restore order, context/RaftContext.java:
         # 91-113), machines from their newest archived snapshot.
         self.state = restore_raft_state(cfg, node_id, self.store, seed=seed)
+        if initial_active is not None:
+            self.state = self.state.replace(
+                active=jnp.asarray(initial_active, bool))
         self._recover_machines()
+        self.h_active = np.asarray(self.state.active).copy()
+
+        # Group lifecycle changes (open/close), applied at the next tick on
+        # the tick thread (reference ContextManager create/exit/destroy,
+        # context/ContextManager.java:112-167).
+        self._lifecycle_lock = threading.Lock()
+        self._lifecycle: List[Tuple[int, bool]] = []
 
         # Host mirrors of per-group device lanes (refreshed each tick).
         G = cfg.n_groups
@@ -156,6 +160,9 @@ class RaftNode:
         future completes with the machine's apply result (reference
         RaftStub.submit -> Promise, command/RaftStub.java:65-74)."""
         fut: Future = Future()
+        if not self.h_active[group]:
+            fut.set_exception(ObsoleteContextError(f"group {group} closed"))
+            return fut
         if self.h_role[group] != LEADER:
             hint = int(self.h_leader[group])
             fut.set_exception(NotLeaderError(
@@ -185,9 +192,46 @@ class RaftNode:
             if dt < interval:
                 time.sleep(interval - dt)
 
+    def set_active(self, group: int, active: bool,
+                   purge: bool = False) -> None:
+        """Open or close a group lane (thread-safe; takes effect next tick).
+        Closing makes the lane inert — no timers, no RPCs, no submissions
+        (reference exitContext, context/ContextManager.java:126-133).
+        ``purge=True`` (destroy) additionally wipes the lane's durable log,
+        machine state, snapshots and device lanes so a future group can
+        reuse it from scratch (reference destroyContext,
+        context/ContextManager.java:139-167)."""
+        with self._lifecycle_lock:
+            self._lifecycle.append((group, active, purge))
+
+    def is_active(self, group: int) -> bool:
+        return bool(self.h_active[group])
+
     def tick(self) -> StepInfo:
         cfg = self.cfg
         G, P = cfg.n_groups, cfg.n_peers
+
+        # -- 0. group lifecycle ----------------------------------------------
+        with self._lifecycle_lock:
+            changes, self._lifecycle = self._lifecycle, []
+        if changes:
+            act = np.asarray(self.state.active).copy()
+            purged = []
+            for g, a, purge in changes:
+                act[g] = a
+                if not a:
+                    # Strand nothing: queued-but-unaccepted submissions AND
+                    # registered promises both fail out when a lane closes.
+                    self.dispatcher.abort_promises(
+                        g, ObsoleteContextError(f"group {g} closed"))
+                    self._reject_submissions(
+                        g, ObsoleteContextError(f"group {g} closed"))
+                if purge:
+                    purged.append(g)
+            self.state = self.state.replace(active=jnp.asarray(act))
+            self.h_active = act
+            if purged:
+                self._purge_lanes(purged)
 
         # -- 1. host inbox ---------------------------------------------------
         submit_n = np.zeros(G, np.int32)
@@ -357,14 +401,57 @@ class RaftNode:
         for k, (_, fut) in enumerate(taken):
             self.dispatcher.register_promise(g, start_idx + k, fut)
 
-    def _reject_submissions(self, g: int) -> None:
+    def _reject_submissions(self, g: int,
+                            exc: Optional[Exception] = None) -> None:
         with self._submit_lock:
             q = self._submissions.get(g, [])
             self._submissions[g] = []
-        hint = self.leader_hint(g)
+        err = exc or NotLeaderError(g, self.leader_hint(g))
         for payload, fut in q:
             if not fut.done():
-                fut.set_exception(NotLeaderError(g, hint))
+                fut.set_exception(err)
+
+    def _purge_lanes(self, lanes: List[int]) -> None:
+        """Wipe destroyed lanes end to end: durable WAL state, machine,
+        archived snapshots, and every device-side lane (term, log, vote,
+        replication bookkeeping) back to boot values."""
+        for g in lanes:
+            self.store.reset_group(g)
+            self.dispatcher.drop_machine(g, destroy=True)
+            self.archive.destroy(g)
+            self.maintain.note_checkpoint(g, 0, 0)
+            self.maintain.snap_index[g] = 0
+            self.maintain.applied_at_snap[g] = 0
+        self.store.sync()
+        idx = jnp.asarray(lanes, I32)
+        s, L, P = self.state, self.cfg.log_slots, self.cfg.n_peers
+        z = jnp.zeros((len(lanes),), I32)
+        self.state = s.replace(
+            term=s.term.at[idx].set(0),
+            role=s.role.at[idx].set(0),
+            voted_for=s.voted_for.at[idx].set(NIL),
+            leader_id=s.leader_id.at[idx].set(NIL),
+            commit=s.commit.at[idx].set(0),
+            applied=s.applied.at[idx].set(0),
+            log=s.log.replace(
+                term=s.log.term.at[idx].set(0),
+                base=s.log.base.at[idx].set(0),
+                base_term=s.log.base_term.at[idx].set(0),
+                last=s.log.last.at[idx].set(0)),
+            next_idx=s.next_idx.at[idx].set(1),
+            match_idx=s.match_idx.at[idx].set(0),
+            awaiting=s.awaiting.at[idx].set(False),
+            sent_at=s.sent_at.at[idx].set(0),
+            need_snap=s.need_snap.at[idx].set(False),
+            votes=s.votes.at[idx].set(False),
+            prevotes=s.prevotes.at[idx].set(False),
+        )
+        # device_get arrays may be read-only views; replace, don't mutate
+        hc = np.array(self.h_commit)
+        hb = np.array(self.h_base)
+        hc[np.asarray(lanes)] = 0
+        hb[np.asarray(lanes)] = 0
+        self.h_commit, self.h_base = hc, hb
 
     @staticmethod
     def _staged_term(arrays, src: int, g: int, idx: int) -> Optional[int]:

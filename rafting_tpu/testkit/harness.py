@@ -52,7 +52,8 @@ class LocalCluster:
         def build(node, on_slice, snapshot_provider):
             return LoopbackTransport(self.net, node_id, self.cfg,
                                      node.template, on_slice,
-                                     snapshot_provider)
+                                     snapshot_provider,
+                                     submit_handler=node.submit)
         return build
 
     def start_node(self, i: int) -> RaftNode:

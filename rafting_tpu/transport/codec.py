@@ -32,7 +32,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 MAGIC = 0x54505552  # "RUPT"
-HELLO, MSGS, SNAP_REQ, SNAP_DATA = 1, 2, 3, 4
+HELLO, MSGS, SNAP_REQ, SNAP_DATA, FWD_REQ, FWD_RESP = 1, 2, 3, 4, 5, 6
 
 MAX_BODY = 64 << 20  # 64 MB cap, matching the reference (EventCodec.java:26)
 
@@ -100,6 +100,44 @@ def pack_snap_req(group: int, index: int, term: int) -> bytes:
 
 def unpack_snap_req(body: bytes) -> Tuple[int, int, int]:
     return struct.unpack("<IQq", body)
+
+
+def pack_fwd_req(group: int, payload: bytes,
+                 timeout_s: float = 30.0) -> bytes:
+    """Client-command forward: a follower relays a submission to the leader
+    (the transport-level analog of the reference's NotLeader redirect hint,
+    support/anomaly/NotLeaderException.java:11-27, resolved inside the
+    cluster instead of bounced to the client).  The client's wait budget
+    travels with the request so the serving side honors it."""
+    tmo_ms = max(1, min(int(timeout_s * 1000), 0xFFFFFFFF))
+    return frame(FWD_REQ, struct.pack("<II", group, tmo_ms) + payload)
+
+
+def unpack_fwd_req(body: bytes) -> Tuple[int, float, bytes]:
+    group, tmo_ms = struct.unpack_from("<II", body, 0)
+    return group, tmo_ms / 1000.0, body[8:]
+
+
+def pack_fwd_resp(ok: bool, result: bytes) -> bytes:
+    return frame(FWD_RESP, struct.pack("<B", 1 if ok else 0) + result)
+
+
+def unpack_fwd_resp(body: bytes) -> Tuple[bool, bytes]:
+    return bool(body[0]), body[1:]
+
+
+def serve_forward(submit_handler: Optional[Callable], group: int,
+                  payload: bytes, timeout_s: float) -> Tuple[bool, bytes]:
+    """Shared serve-side forward contract (TCP and loopback): run the
+    submission, JSON-encode the apply result, 'TypeName: msg' on error."""
+    import json as _json
+    if submit_handler is None:
+        return False, b"forwarding disabled"
+    try:
+        fut = submit_handler(group, payload)
+        return True, _json.dumps(fut.result(timeout=timeout_s)).encode()
+    except Exception as e:
+        return False, f"{type(e).__name__}: {e}".encode()
 
 
 def pack_snap_data(group: int, index: int, term: int, ok: bool,

@@ -48,13 +48,15 @@ class LoopbackNetwork:
 class LoopbackTransport:
     def __init__(self, network: LoopbackNetwork, node_id: int, cfg, template,
                  on_slice: Callable,
-                 snapshot_provider: Optional[Callable] = None):
+                 snapshot_provider: Optional[Callable] = None,
+                 submit_handler: Optional[Callable] = None):
         self.net = network
         self.node_id = node_id
         self.cfg = cfg
         self.template = template
         self.on_slice = on_slice
         self.snapshot_provider = snapshot_provider
+        self.submit_handler = submit_handler
 
     def start(self) -> None:
         self.net.transports[self.node_id] = self
@@ -76,6 +78,16 @@ class LoopbackTransport:
                 src, fields, payloads = codec.unpack_slice(
                     body, t.template, t.cfg.n_groups)
                 t.on_slice(src, fields, payloads)
+
+    def forward_submit(self, peer: int, group: int, payload: bytes,
+                       timeout: float = 30.0):
+        if not (self.net._up(self.node_id, peer)
+                and self.net._up(peer, self.node_id)):
+            return False, b"link down"
+        t = self.net.transports.get(peer)
+        if t is None:
+            return False, b"peer down"
+        return codec.serve_forward(t.submit_handler, group, payload, timeout)
 
     def fetch_snapshot(self, peer: int, group: int, index: int, term: int,
                        timeout: float = 60.0

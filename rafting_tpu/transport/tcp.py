@@ -114,13 +114,17 @@ class TcpTransport:
     def __init__(self, node_id: int, peers: Dict[int, Tuple[str, int]],
                  cfg, template,
                  on_slice: Callable,
-                 snapshot_provider: Optional[Callable] = None):
+                 snapshot_provider: Optional[Callable] = None,
+                 submit_handler: Optional[Callable] = None):
+        """``submit_handler(group, payload) -> Future`` serves forwarded
+        client commands (None -> forwards are refused)."""
         self.node_id = node_id
         self.peers = peers
         self.cfg = cfg
         self.template = template
         self.on_slice = on_slice
         self.snapshot_provider = snapshot_provider
+        self.submit_handler = submit_handler
         self._hello = codec.pack_hello(node_id, cfg.n_groups, cfg.n_peers,
                                        cfg.batch)
         self._senders: Dict[int, PeerSender] = {}
@@ -248,6 +252,9 @@ class TcpTransport:
                     elif ftype == codec.SNAP_REQ:
                         self._serve_snapshot(conn, body)
                         return  # ephemeral connection: one fetch, then close
+                    elif ftype == codec.FWD_REQ:
+                        self._serve_forward(conn, body)
+                        return  # ephemeral: one command, then close
         except (OSError, IOError):
             pass
         finally:
@@ -255,6 +262,33 @@ class TcpTransport:
                 conn.close()
             except OSError:
                 pass
+
+    def forward_submit(self, peer: int, group: int, payload: bytes,
+                       timeout: float = 30.0
+                       ) -> Tuple[bool, bytes]:
+        """Relay a client command to ``peer`` and wait for the apply result
+        (JSON bytes).  Blocking — call from a worker/client thread."""
+        try:
+            with socket.create_connection(self.peers[peer],
+                                          timeout=timeout) as sock:
+                sock.settimeout(timeout + 1.0)  # serve side bounds the wait
+                sock.sendall(codec.pack_fwd_req(group, payload, timeout))
+                reader = codec.FrameReader()
+                while True:
+                    data = sock.recv(1 << 20)
+                    if not data:
+                        return False, b"connection closed"
+                    for ftype, body in reader.feed(data):
+                        if ftype == codec.FWD_RESP:
+                            return codec.unpack_fwd_resp(body)
+        except OSError as e:
+            return False, str(e).encode()
+
+    def _serve_forward(self, conn: socket.socket, body: bytes):
+        group, timeout_s, payload = codec.unpack_fwd_req(body)
+        ok, res = codec.serve_forward(self.submit_handler, group, payload,
+                                      timeout_s)
+        conn.sendall(codec.pack_fwd_resp(ok, res))
 
     def _serve_snapshot(self, conn: socket.socket, body: bytes):
         group, index, term = codec.unpack_snap_req(body)

@@ -1,0 +1,201 @@
+"""Admin control-plane tests: MVCC KV engine + STM semantics, Administrator
+command dispatch/checkpoint, and replicated group lifecycle over a real
+3-container TCP cluster."""
+
+import json
+import os
+import socket
+import time
+
+import pytest
+
+from rafting_tpu.admin import (
+    DESTROYED, NORMAL, SLEEPING, Administrator, KVEngine, LifecycleBus, STM,
+    build_close_tx, build_open_tx,
+)
+from rafting_tpu.api import RaftConfig, RaftContainer
+from rafting_tpu.machine.spi import Checkpoint
+
+
+# ------------------------------------------------------------------ KV/STM --
+
+def test_kv_optimistic_commit_and_conflict():
+    kv = KVEngine()
+    t1, t2 = kv.next_tx(), kv.next_tx()
+    # two transactions race on the same key from the same snapshot
+    s1, s2 = STM(kv), STM(kv)
+    assert s1.get("x") is None and s2.get("x") is None
+    s1.put("x", "a")
+    s2.put("x", "b")
+    assert kv.commit_tx(t1, s1.mods())          # first wins
+    assert not kv.commit_tx(t2, s2.mods())      # second conflicts
+    assert kv.get("x") == ("a", t1)
+    # a fresh read sees the new version and can update it
+    s3 = STM(kv)
+    assert s3.get("x") == "a"
+    s3.put("x", "c")
+    t3 = kv.next_tx()
+    assert kv.commit_tx(t3, s3.mods())
+    assert kv.get("x") == ("c", t3)
+
+
+def test_kv_delete_and_dump_load(tmp_path):
+    kv = KVEngine()
+    t = kv.next_tx()
+    assert kv.commit_tx(t, {"a": (0, 1), "b": (0, 2)})
+    t2 = kv.next_tx()
+    assert kv.commit_tx(t2, {"a": (t, None)})   # delete
+    assert kv.get("a") is None and kv.get("b") == (2, t)
+    p = str(tmp_path / "kv.json")
+    kv.dump(p)
+    kv2 = KVEngine()
+    kv2.load(p)
+    assert kv2.data == kv.data and kv2.last_tx == kv.last_tx
+
+
+# ------------------------------------------------------------ Administrator --
+
+def test_administrator_apply_and_lifecycle_effects(tmp_path):
+    bus = LifecycleBus()
+    events = []
+    bus.bind(lambda *ev: events.append(ev))
+    adm = Administrator(str(tmp_path / "admin"), n_groups=8, bus=bus)
+    assert adm.apply(1, json.dumps({"op": "echo", "v": 42}).encode()) == 42
+    tx = adm.apply(2, json.dumps({"op": "next_tx"}).encode())
+    cmd = build_open_tx(adm, "root", 8, tx)
+    res = adm.apply(3, json.dumps(cmd).encode())
+    assert res["ok"]
+    assert events[-1] == ("root", 1, NORMAL)
+    assert adm.status_of("root") == (NORMAL, 1)
+    # reopening is a no-op
+    assert build_open_tx(adm, "root", 8, 99) is None
+    # close -> SLEEPING keeps the lane; reopen reuses it
+    tx = adm.apply(4, json.dumps({"op": "next_tx"}).encode())
+    adm.apply(5, json.dumps(build_close_tx(adm, "root", tx)).encode())
+    assert adm.status_of("root") == (SLEEPING, 1)
+    assert events[-1] == ("root", 1, SLEEPING)
+    tx = adm.apply(6, json.dumps({"op": "next_tx"}).encode())
+    adm.apply(7, json.dumps(build_open_tx(adm, "root", 8, tx)).encode())
+    assert adm.status_of("root") == (NORMAL, 1)
+    # destroy frees the lane; the next open allocates a different one only
+    # if another group claimed lane 1 meanwhile
+    tx = adm.apply(8, json.dumps({"op": "next_tx"}).encode())
+    adm.apply(9, json.dumps(
+        build_close_tx(adm, "root", tx, destroy=True)).encode())
+    assert adm.status_of("root")[0] == DESTROYED
+    assert 1 not in adm.used_lanes()
+
+
+def test_administrator_checkpoint_recover_reopens_groups(tmp_path):
+    bus = LifecycleBus()
+    adm = Administrator(str(tmp_path / "admin"), n_groups=8, bus=bus)
+    tx = adm.apply(1, json.dumps({"op": "next_tx"}).encode())
+    adm.apply(2, json.dumps(build_open_tx(adm, "g1", 8, tx)).encode())
+    ckpt = adm.checkpoint(0)
+    assert ckpt.index == 2
+    # fresh instance + bus: recover must re-emit NORMAL for g1 even before
+    # a handler binds (queued), reference Administrator.java:50-57
+    bus2 = LifecycleBus()
+    adm2 = Administrator(str(tmp_path / "admin2"), n_groups=8, bus=bus2)
+    adm2.recover(Checkpoint(path=ckpt.path, index=ckpt.index))
+    got = []
+    bus2.bind(lambda *ev: got.append(ev))
+    assert ("g1", 1, NORMAL) in got
+    assert adm2.last_applied() == 2
+
+
+# ------------------------------------------------- replicated lifecycle -----
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def test_destroy_purges_lane_for_reuse(tmp_path):
+    """A destroyed group's lane must come back EMPTY for the next group:
+    no leaked WAL entries, machine files, snapshots or device state
+    (reference destroyContext deletes the RocksDB dir,
+    command/storage/RocksStateLoader.java:48-59)."""
+    from rafting_tpu.core.types import EngineConfig
+    from rafting_tpu.testkit.harness import LocalCluster
+
+    cfg = EngineConfig(n_groups=3, n_peers=3, log_slots=16, batch=4,
+                       max_submit=4)
+    c = LocalCluster(cfg, str(tmp_path))
+    try:
+        c.wait_leader(1)
+        for k in range(3):
+            c.submit_via_leader(1, f"old-{k}".encode())
+        c.tick(5)
+        assert len(c.machine_lines(c.leader_of(1), 1)) == 3
+        for node in c.nodes.values():
+            node.set_active(1, False, purge=True)
+        c.tick(3)
+        # lane wiped everywhere: device log empty, WAL tail 0, machine gone
+        for i, node in c.nodes.items():
+            assert node.store.tail(1) == 0
+            assert node.store.stable(1) is None
+            assert int(node.state.log.last[1]) == 0
+            assert int(node.state.term[1]) == 0
+            assert c.machine_lines(i, 1) == []
+        # reuse: reopen the lane; history starts from index 1
+        for node in c.nodes.values():
+            node.set_active(1, True)
+        c.wait_leader(1)
+        assert c.submit_via_leader(1, b"new-0") == 1
+        c.tick(5)
+        lead = c.leader_of(1)
+        assert c.machine_lines(lead, 1) == ["1:new-0\n"]
+    finally:
+        c.close()
+
+
+def test_replicated_group_lifecycle_tcp(tmp_path):
+    ports = _free_ports(3)
+    uris = [f"raft://127.0.0.1:{p}" for p in ports]
+    cs = []
+    for i in range(3):
+        cfg = RaftConfig(
+            local=uris[i],
+            peers=tuple(u for j, u in enumerate(uris) if j != i),
+            n_groups=4, log_slots=32, batch=4, max_submit=4,
+            tick_ms=10, data_dir=str(tmp_path / f"node{i}"), seed=3)
+        cs.append(RaftContainer(cfg).create())
+    try:
+        # ONE node opens; the lifecycle replicates to all.
+        lane = cs[0].open_context("root", timeout=60)
+        assert lane == 1
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if all(c.node.is_active(lane) for c in cs):
+                break
+            time.sleep(0.02)
+        assert all(c.node.is_active(lane) for c in cs), \
+            "open did not replicate to all nodes"
+        # Idempotent re-open from another node returns the same lane.
+        assert cs[1].open_context("root", timeout=60) == lane
+        # The opened group elects and serves commands.
+        deadline = time.time() + 30
+        lead = None
+        while time.time() < deadline and lead is None:
+            lead = next((c for c in cs if c.node.is_leader(lane)), None)
+            time.sleep(0.02)
+        assert lead is not None
+        assert lead.get_stub("root").execute("cmd-1", timeout=30) == 1
+        # Close from a different node than the opener.
+        cs[2].close_context("root", timeout=60)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if not any(c.node.is_active(lane) for c in cs):
+                break
+            time.sleep(0.02)
+        assert not any(c.node.is_active(lane) for c in cs)
+    finally:
+        for c in cs:
+            c.destroy()
