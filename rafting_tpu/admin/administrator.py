@@ -38,23 +38,27 @@ NOT_FOUND, NORMAL, SLEEPING, DESTROYED = \
 class LifecycleBus:
     """Late-bound sink for lane open/close effects: the Administrator is
     constructed before the node exists, so effects queue until a handler
-    binds, then flush in order."""
+    binds, then flush in order.  Events carry the lane INCARNATION (``gen``)
+    — bumped every time a lane is allocated to a new group — so a node that
+    missed a destroy (e.g. it caught up via a meta-group snapshot) can
+    detect that its local lane state belongs to a dead incarnation and
+    purge before activating."""
 
     def __init__(self):
-        self._handler: Optional[Callable[[str, int, str], None]] = None
-        self._pending: List[Tuple[str, int, str]] = []
+        self._handler: Optional[Callable[[str, int, str, int], None]] = None
+        self._pending: List[Tuple[str, int, str, int]] = []
 
-    def bind(self, handler: Callable[[str, int, str], None]) -> None:
+    def bind(self, handler: Callable[[str, int, str, int], None]) -> None:
         self._handler = handler
         pending, self._pending = self._pending, []
         for ev in pending:
             handler(*ev)
 
-    def emit(self, name: str, lane: int, status: str) -> None:
+    def emit(self, name: str, lane: int, status: str, gen: int = 0) -> None:
         if self._handler is None:
-            self._pending.append((name, lane, status))
+            self._pending.append((name, lane, status, gen))
         else:
-            self._handler(name, lane, status)
+            self._handler(name, lane, status, gen)
 
 
 class Administrator:
@@ -107,10 +111,25 @@ class Administrator:
     def recover(self, checkpoint: Checkpoint) -> None:
         self.engine.load(checkpoint.path)
         self._last_applied = checkpoint.index
-        # Re-create every NORMAL group (reference Administrator.java:50-57).
+        # Reconcile EVERY lane with the recovered table, not just NORMAL
+        # groups (reference restart re-creation, Administrator.java:50-57,
+        # extended to closures a lagging replica may have skipped over a
+        # meta snapshot).  Per lane the living context wins:
+        # NORMAL > SLEEPING > DESTROYED.
+        rank = {NORMAL: 2, SLEEPING: 1, DESTROYED: 0}
+        by_lane: Dict[int, Tuple[str, str, int]] = {}
         for name, lane, status in self.contexts():
-            if status == NORMAL:
-                self.bus.emit(name, lane, NORMAL)
+            if lane is None:
+                continue
+            cur = by_lane.get(lane)
+            if cur is None or rank[status] > rank[cur[1]]:
+                by_lane[lane] = (name, status, self._ctx_gen(name))
+        for lane, (name, status, gen) in sorted(by_lane.items()):
+            self.bus.emit(name, lane, status, gen)
+
+    def _ctx_gen(self, name: str) -> int:
+        ent = self.engine.get(f"ctx:{name}")
+        return ent[0].get("gen", 0) if ent is not None else 0
 
     def close(self) -> None:
         pass
@@ -144,7 +163,8 @@ class Administrator:
     def _fire_effects(self, mods: Dict[str, Tuple[int, Any]]) -> None:
         for key, (_, val) in mods.items():
             if key.startswith("ctx:") and val is not None:
-                self.bus.emit(key[4:], val.get("lane"), val["status"])
+                self.bus.emit(key[4:], val.get("lane"), val["status"],
+                              val.get("gen", 0))
 
     def _ckpt_file(self) -> str:
         files = sorted(
@@ -172,15 +192,21 @@ def build_open_tx(admin: Administrator, name: str, n_groups: int,
     if ent is not None and ent["status"] == NORMAL:
         return None
     if ent is not None and ent["status"] != DESTROYED:
-        lane = ent["lane"]           # SLEEPING -> wake on the same lane
+        # SLEEPING -> wake on the same lane, SAME incarnation (its durable
+        # state belongs to this group and must survive the nap).
+        lane, gen = ent["lane"], ent.get("gen", 0)
     else:
         used = admin.used_lanes()
         lane = next((l for l in range(1, n_groups) if l not in used), None)
         if lane is None:
             from ..api.anomaly import RaftError
             raise RaftError(f"no free group lanes (n_groups={n_groups})")
+        # Fresh allocation: bump the lane's incarnation so every node
+        # purges any leftover state from a prior (destroyed) tenant.
+        gen = (stm.get(f"lane_gen:{lane}") or 0) + 1
+        stm.put(f"lane_gen:{lane}", gen)
     stm.put("admin_seq", seq + 1)
-    stm.put(f"ctx:{name}", {"status": NORMAL, "lane": lane})
+    stm.put(f"ctx:{name}", {"status": NORMAL, "lane": lane, "gen": gen})
     return {"op": "tx", "tx": tx_id, "mods": stm.mods()}
 
 
@@ -197,7 +223,8 @@ def build_close_tx(admin: Administrator, name: str, tx_id: int,
         return None
     stm.put("admin_seq", seq + 1)
     stm.put(f"ctx:{name}", {"status": DESTROYED if destroy else SLEEPING,
-                            "lane": ent["lane"]})
+                            "lane": ent["lane"],
+                            "gen": ent.get("gen", 0)})
     return {"op": "tx", "tx": tx_id, "mods": stm.mods()}
 
 

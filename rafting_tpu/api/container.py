@@ -160,10 +160,19 @@ class RaftContainer:
         atexit.register(self.destroy)
         return self
 
-    def _on_lifecycle(self, name: str, lane: int, status: str) -> None:
+    def _on_lifecycle(self, name: str, lane: int, status: str,
+                      gen: int = 0) -> None:
         from ..admin.administrator import DESTROYED, NORMAL
-        self._node.set_active(lane, status == NORMAL,
-                              purge=(status == DESTROYED))
+        if status == NORMAL:
+            # gen mismatch purges a dead incarnation before activating.
+            self._node.activate_lane(lane, gen)
+        else:
+            self._node.set_active(lane, False, purge=(status == DESTROYED))
+        if status == DESTROYED:
+            # A destroyed name's cached stubs must never route again; the
+            # lane may be re-allocated to a different group.
+            with self._stub_lock:
+                self._stubs.pop(name, None)
 
     @property
     def node(self):
@@ -198,12 +207,20 @@ class RaftContainer:
             self._node.set_active(lane, True)
             return lane
         from ..admin.administrator import NORMAL, build_open_tx
-        return self._lifecycle_tx(
+        lane = self._lifecycle_tx(
             name, timeout,
             lambda adm, tx: build_open_tx(adm, name, self.config.n_groups,
                                           tx),
             lambda st: st == NORMAL,
             f"open of group {name!r}")
+        # The committed open queues lane activation for the next tick; wait
+        # for it so an immediate get_stub().submit() can't race a lane
+        # that is still inert.
+        import time as _time
+        deadline = _time.monotonic() + max(1.0, timeout / 2)
+        while not self._node.is_active(lane) and _time.monotonic() < deadline:
+            _time.sleep(self.config.tick_interval / 2)
+        return lane
 
     def close_context(self, name: str, destroy_group: bool = False,
                       timeout: float = 30.0) -> None:
@@ -225,7 +242,13 @@ class RaftContainer:
                 self.registry.mark_closed(name)
                 self._node.set_active(lane, False)
             return
-        from ..admin.administrator import DESTROYED, SLEEPING, build_close_tx
+        from ..admin.administrator import (
+            DESTROYED, NOT_FOUND, SLEEPING, build_close_tx,
+        )
+        status, _ = self._admin_provider.admin.status_of(name)
+        if status == NOT_FOUND:
+            # Fail fast — retrying can't make an unknown group closeable.
+            raise ObsoleteContextError(f"unknown group {name!r}")
         want = DESTROYED if destroy_group else SLEEPING
         self._lifecycle_tx(
             name, timeout,
@@ -266,20 +289,22 @@ class RaftContainer:
             if reached(status):
                 return lane
             step_timeout = max(0.1, min(5.0, deadline - _time.monotonic()))
-            try:
-                tx = self._admin_submit({"op": "next_tx"}, step_timeout)
-            except Exception:
+            # Probe the builder BEFORE spending a replicated next_tx: if
+            # there is nothing to do locally (state not yet replicated to
+            # this node), just wait — don't spam the meta log.  Permanent
+            # errors (e.g. no free lanes) surface immediately.
+            if build(adm, 0) is None:
                 _time.sleep(self.config.tick_interval)
                 continue
-            # Permanent errors from the tx builder (e.g. no free lanes)
-            # surface immediately — retrying can't fix capacity.
-            cmd = build(adm, tx)
-            if cmd is None:   # nothing to do anymore (idempotent)
-                continue
             try:
-                self._admin_submit(cmd, step_timeout)
-                # On success the apply fires lifecycle effects; on conflict
-                # {"ok": False} we re-loop and rebuild the tx.
+                tx = self._admin_submit({"op": "next_tx"}, step_timeout)
+                cmd = build(adm, tx)
+                if cmd is None:   # resolved while we allocated the tx
+                    continue
+                res = self._admin_submit(cmd, step_timeout)
+                if isinstance(res, dict) and not res.get("ok", True):
+                    # Optimistic conflict: back off a tick, then rebuild.
+                    _time.sleep(self.config.tick_interval)
             except Exception:
                 _time.sleep(self.config.tick_interval)
         raise WaitTimeoutError(f"{what} did not commit in {timeout}s")

@@ -30,9 +30,20 @@ class RaftStub:
         must be JSON-serializable)."""
         self._container = container
         self.name = name
-        self.lane = lane
+        self._lane = lane
         self.forward = forward
         self._closed = False
+
+    @property
+    def lane(self) -> int:
+        """Resolved per use: after a destroy/re-open cycle the NAME may map
+        to a different lane, and a cached stub must never route commands
+        into another group's log."""
+        cur = self._container._lookup(self.name)
+        if cur is None:
+            raise ObsoleteContextError(f"group {self.name!r} not open")
+        self._lane = cur
+        return cur
 
     def submit(self, command: Union[bytes, str]) -> Future:
         """Async submit (reference RaftStub.submit -> Promise,
@@ -60,15 +71,30 @@ class RaftStub:
 
     def _forwarded(self, payload: bytes) -> Future:
         """Relay to the leader from a worker thread (the forward channel is
-        a blocking ephemeral connection)."""
+        a blocking ephemeral connection).  During an election there may be
+        no leader hint yet — poll briefly instead of failing instantly."""
         node = self._container._node
+        lane = self.lane
         out: Future = Future()
 
         def run():
+            import time as _time
             try:
-                hint = node.leader_hint(self.lane)
-                if hint is None:
-                    raise NotLeaderError(self.lane, None)
+                deadline = _time.monotonic() + 5.0
+                while True:
+                    if node.is_leader(lane):
+                        # leadership landed HERE while we waited: local
+                        # submit (still one attempt, never a resubmit)
+                        fut = node.submit(lane, payload)
+                        res = fut.result(timeout=30)
+                        out.set_result(res)
+                        return
+                    hint = node.leader_hint(lane)
+                    if hint is not None and hint != node.node_id:
+                        break
+                    if _time.monotonic() >= deadline:
+                        raise NotLeaderError(lane, None)
+                    _time.sleep(0.05)
                 ok, raw = node.transport.forward_submit(
                     hint, self.lane, payload, timeout=30)
                 if not ok:

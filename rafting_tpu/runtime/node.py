@@ -30,6 +30,7 @@ the device engine actually accepted (StepInfo.appended_from/to).
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import threading
@@ -99,13 +100,24 @@ class RaftNode:
         # context/ContextManager.java:112-167).
         self._lifecycle_lock = threading.Lock()
         self._lifecycle: List[Tuple[int, bool]] = []
+        # Lane incarnations this node has activated: when the admin layer
+        # re-allocates a lane to a NEW group (gen bump) and this node missed
+        # the destroy (meta-snapshot catch-up), the gen mismatch forces a
+        # purge before activation.
+        self._lane_gens_path = os.path.join(data_dir, "lane_gens.json")
+        self._lane_gens: Dict[str, int] = {}
+        if os.path.exists(self._lane_gens_path):
+            try:
+                with open(self._lane_gens_path) as f:
+                    self._lane_gens = json.load(f)
+            except (OSError, ValueError):
+                self._lane_gens = {}
 
         # Host mirrors of per-group device lanes (refreshed each tick).
         G = cfg.n_groups
         self.h_role = np.zeros(G, np.int32)
         self.h_leader = np.full(G, NIL, np.int32)
         self.h_term = np.asarray(self.state.term).copy()
-        self.h_voted = np.asarray(self.state.voted_for).copy()
         self.h_commit = np.asarray(self.state.commit).copy()
         self.h_base = np.asarray(self.state.log.base).copy()
 
@@ -207,6 +219,24 @@ class RaftNode:
     def is_active(self, group: int) -> bool:
         return bool(self.h_active[group])
 
+    def activate_lane(self, lane: int, gen: int) -> None:
+        """Activate a lane for incarnation ``gen``: if the lane last served
+        an older incarnation, purge it first so the new group starts from
+        scratch (covers a destroy this node never saw)."""
+        known = self._lane_gens.get(str(lane), 0)
+        if gen > known:
+            if known > 0 or self.store.tail(lane) > 0 \
+                    or self.store.stable(lane) is not None:
+                self.set_active(lane, False, purge=True)
+            self._lane_gens[str(lane)] = gen
+            tmp = self._lane_gens_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self._lane_gens, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._lane_gens_path)
+        self.set_active(lane, True)
+
     def tick(self) -> StepInfo:
         cfg = self.cfg
         G, P = cfg.n_groups, cfg.n_peers
@@ -273,6 +303,7 @@ class RaftNode:
         old_role = self.h_role
         self.h_role, self.h_leader = h_role, h_leader
         self.h_commit, self.h_base = h_commit, h_base
+        self.h_term = h_term
         self.metrics["elections"] += int(
             ((h_role == LEADER) & (old_role != LEADER)).sum())
         # Leadership lost: abort outstanding client promises BEFORE any
@@ -418,7 +449,8 @@ class RaftNode:
         for g in lanes:
             self.store.reset_group(g)
             self.dispatcher.drop_machine(g, destroy=True)
-            self.archive.destroy(g)
+            self.archive.destroy(g)     # also clears any pending download
+            self._snap_inflight.discard(g)
             self.maintain.note_checkpoint(g, 0, 0)
             self.maintain.snap_index[g] = 0
             self.maintain.applied_at_snap[g] = 0
@@ -575,6 +607,14 @@ class RaftNode:
         done = []
         for g, got_idx, got_term, tmp in fetched:
             try:
+                # The lane may have been closed/destroyed while the fetch
+                # was in flight (purge clears archive pending): discard.
+                if not self.h_active[g] or self.archive.pending(g) is None:
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
+                    continue
                 snap = self.archive.install_pending(g, tmp, got_idx, got_term)
                 self.dispatcher.resume_from(
                     g, Checkpoint(path=snap.path, index=snap.index))

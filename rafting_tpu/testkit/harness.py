@@ -23,6 +23,21 @@ from ..runtime.node import RaftNode
 from ..transport import LoopbackNetwork, LoopbackTransport
 
 
+def free_ports(n: int) -> List[int]:
+    """Reserve n distinct free localhost TCP ports (close-then-reuse; the
+    usual bind(0) probe, shared by every TCP-based test)."""
+    import socket
+    socks = [socket.socket() for _ in range(n)]
+    try:
+        for s in socks:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
 class LocalCluster:
     def __init__(self, cfg: EngineConfig, root: str,
                  provider_factory: Optional[Callable[[int], object]] = None,
@@ -103,10 +118,19 @@ class LocalCluster:
     # -- queries -------------------------------------------------------------
 
     def leader_of(self, group: int) -> Optional[int]:
-        leaders = [i for i, n in self.nodes.items()
+        """Current leader (highest term if a stale minority leader is still
+        deposed-but-unaware).  The election-safety invariant is at most one
+        leader per (group, TERM) — two leaders at the SAME term is split
+        brain (reference one-leader-per-term asserts, Follower.java:48-50,
+        Leader.java:79-81); a stale lower-term claimant is legal Raft."""
+        leaders = [(i, int(n.h_term[group])) for i, n in self.nodes.items()
                    if n.h_role[group] == LEADER]
-        assert len(leaders) <= 1, f"split brain in group {group}: {leaders}"
-        return leaders[0] if leaders else None
+        terms = [t for _, t in leaders]
+        assert len(terms) == len(set(terms)), \
+            f"split brain in group {group}: same-term leaders {leaders}"
+        if not leaders:
+            return None
+        return max(leaders, key=lambda it: it[1])[0]
 
     def wait_leader(self, group: int, max_rounds: int = 500) -> int:
         self.tick_until(lambda: self.leader_of(group) is not None,

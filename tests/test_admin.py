@@ -9,6 +9,8 @@ import time
 
 import pytest
 
+from rafting_tpu.testkit.harness import free_ports as _free_ports
+
 from rafting_tpu.admin import (
     DESTROYED, NORMAL, SLEEPING, Administrator, KVEngine, LifecycleBus, STM,
     build_close_tx, build_open_tx,
@@ -65,7 +67,7 @@ def test_administrator_apply_and_lifecycle_effects(tmp_path):
     cmd = build_open_tx(adm, "root", 8, tx)
     res = adm.apply(3, json.dumps(cmd).encode())
     assert res["ok"]
-    assert events[-1] == ("root", 1, NORMAL)
+    assert events[-1] == ("root", 1, NORMAL, 1)
     assert adm.status_of("root") == (NORMAL, 1)
     # reopening is a no-op
     assert build_open_tx(adm, "root", 8, 99) is None
@@ -73,7 +75,7 @@ def test_administrator_apply_and_lifecycle_effects(tmp_path):
     tx = adm.apply(4, json.dumps({"op": "next_tx"}).encode())
     adm.apply(5, json.dumps(build_close_tx(adm, "root", tx)).encode())
     assert adm.status_of("root") == (SLEEPING, 1)
-    assert events[-1] == ("root", 1, SLEEPING)
+    assert events[-1] == ("root", 1, SLEEPING, 1)
     tx = adm.apply(6, json.dumps({"op": "next_tx"}).encode())
     adm.apply(7, json.dumps(build_open_tx(adm, "root", 8, tx)).encode())
     assert adm.status_of("root") == (NORMAL, 1)
@@ -100,21 +102,81 @@ def test_administrator_checkpoint_recover_reopens_groups(tmp_path):
     adm2.recover(Checkpoint(path=ckpt.path, index=ckpt.index))
     got = []
     bus2.bind(lambda *ev: got.append(ev))
-    assert ("g1", 1, NORMAL) in got
+    assert ("g1", 1, NORMAL, 1) in got
     assert adm2.last_applied() == 2
 
 
 # ------------------------------------------------- replicated lifecycle -----
 
-def _free_ports(n):
-    socks = [socket.socket() for _ in range(n)]
-    for s in socks:
-        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        s.bind(("127.0.0.1", 0))
-    ports = [s.getsockname()[1] for s in socks]
-    for s in socks:
-        s.close()
-    return ports
+
+
+def test_recover_reconciles_closures_and_reuse(tmp_path):
+    """recover() must reconcile EVERY lane, not just re-open NORMAL groups:
+    closures skipped over a meta snapshot are applied, and a lane reused by
+    a new group carries a bumped incarnation so stale state gets purged."""
+    bus = LifecycleBus()
+    adm = Administrator(str(tmp_path / "a"), n_groups=8, bus=bus)
+    i = [0]
+
+    def ap(cmd):
+        i[0] += 1
+        return adm.apply(i[0], json.dumps(cmd).encode())
+
+    tx = ap({"op": "next_tx"})
+    ap(build_open_tx(adm, "old", 8, tx))           # lane 1, gen 1
+    tx = ap({"op": "next_tx"})
+    ap(build_close_tx(adm, "old", tx, destroy=True))
+    tx = ap({"op": "next_tx"})
+    ap(build_open_tx(adm, "new", 8, tx))           # lane 1 reused, gen 2
+    tx = ap({"op": "next_tx"})
+    ap(build_open_tx(adm, "napper", 8, tx))        # lane 2, gen 1
+    tx = ap({"op": "next_tx"})
+    ap(build_close_tx(adm, "napper", tx))          # SLEEPING
+    ckpt = adm.checkpoint(0)
+
+    bus2 = LifecycleBus()
+    adm2 = Administrator(str(tmp_path / "b"), n_groups=8, bus=bus2)
+    adm2.recover(Checkpoint(path=ckpt.path, index=ckpt.index))
+    got = []
+    bus2.bind(lambda *ev: got.append(ev))
+    # lane 1: the LIVING context ("new", gen 2) wins over the destroyed one
+    assert ("new", 1, NORMAL, 2) in got
+    assert not any(ev[1] == 1 and ev[2] == DESTROYED for ev in got)
+    # lane 2: the skipped closure is applied
+    assert ("napper", 2, SLEEPING, 1) in got
+
+
+def test_activate_lane_purges_stale_incarnation(tmp_path):
+    """A node whose lane holds a dead incarnation's state must wipe it when
+    the lane activates for a NEW group (gen bump) — covers destroys missed
+    via meta-snapshot catch-up."""
+    from rafting_tpu.core.types import EngineConfig
+    from rafting_tpu.testkit.harness import LocalCluster
+
+    cfg = EngineConfig(n_groups=3, n_peers=3, log_slots=16, batch=4,
+                       max_submit=4)
+    c = LocalCluster(cfg, str(tmp_path))
+    try:
+        node = c.nodes[0]
+        # Incarnation 1 recorded at open time (lane empty, nothing purged).
+        node.activate_lane(1, 1)
+        c.wait_leader(1)
+        c.submit_via_leader(1, b"tenant-one")
+        c.tick(5)
+        assert node.store.tail(1) > 0
+        # Re-activation at the SAME incarnation (e.g. wake from SLEEPING):
+        # the state belongs to this group and must survive.
+        node.activate_lane(1, 1)
+        c.tick(2)
+        assert node.store.tail(1) > 0
+        # New incarnation (the admin layer re-allocated the lane after a
+        # destroy this node never saw): purge before activating.
+        node.activate_lane(1, 2)
+        c.tick(2)
+        assert node.store.tail(1) == 0
+        assert node.is_active(1)
+    finally:
+        c.close()
 
 
 def test_destroy_purges_lane_for_reuse(tmp_path):
@@ -180,11 +242,17 @@ def test_replicated_group_lifecycle_tcp(tmp_path):
             "open did not replicate to all nodes"
         # Idempotent re-open from another node returns the same lane.
         assert cs[1].open_context("root", timeout=60) == lane
-        # The opened group elects and serves commands.
+        # The opened group elects and serves commands (wait for leadership
+        # to stabilize past the post-open election churn).
         deadline = time.time() + 30
         lead = None
-        while time.time() < deadline and lead is None:
-            lead = next((c for c in cs if c.node.is_leader(lane)), None)
+        while time.time() < deadline:
+            cand = next((c for c in cs if c.node.is_leader(lane)), None)
+            if cand is not None:
+                time.sleep(0.3)
+                if cand.node.is_leader(lane):
+                    lead = cand
+                    break
             time.sleep(0.02)
         assert lead is not None
         assert lead.get_stub("root").execute("cmd-1", timeout=30) == 1

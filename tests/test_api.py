@@ -10,21 +10,14 @@ import time
 import numpy as np
 import pytest
 
+from rafting_tpu.testkit.harness import free_ports as _free_ports
+
 from rafting_tpu.api import (
     ADMIN_GROUP, NotLeaderError, ObsoleteContextError, RaftConfig,
     RaftContainer, RaftError, WaitTimeoutError, load_xml_config,
 )
 
 
-def _free_ports(n):
-    socks = [socket.socket() for _ in range(n)]
-    for s in socks:
-        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        s.bind(("127.0.0.1", 0))
-    ports = [s.getsockname()[1] for s in socks]
-    for s in socks:
-        s.close()
-    return ports
 
 
 # ---------------------------------------------------------------- config ----
@@ -105,12 +98,24 @@ def _wait(containers, pred, what, rounds=800):
     raise AssertionError(f"{what} not reached")
 
 
+def _stable_leader(cs, lane, hold=0.3):
+    """Leader that RETAINED leadership for `hold` seconds — skips the early
+    post-open churn window where colliding elections depose each other."""
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        _wait(cs, lambda: any(c.node.is_leader(lane) for c in cs), "leader")
+        lead = next(c for c in cs if c.node.is_leader(lane))
+        time.sleep(hold)
+        if lead.node.is_leader(lane):
+            return lead
+    raise AssertionError("no stable leader")
+
+
 def test_container_end_to_end_tcp(tcp_cluster):
     cs = tcp_cluster
     for c in cs:
         assert c.open_context("root") == 1  # lane 0 is @raft
-    _wait(cs, lambda: any(c.node.is_leader(1) for c in cs), "leader")
-    lead = next(c for c in cs if c.node.is_leader(1))
+    lead = _stable_leader(cs, 1)
     stub = lead.get_stub("root")
     fut = stub.submit("first-command")
     _wait(cs, fut.done, "commit")

@@ -1,0 +1,103 @@
+"""Runtime chaos: random partitions, node crashes and restarts under
+continuous load, against the full node runtime (device engine + WAL +
+machines + snapshots) on the loopback transport.
+
+Oracles, checked continuously and at convergence (the reference's manual
+kill/restart procedure made systematic, README.md:28-33 + the invariant
+asserts scattered through its code):
+
+* never more than one leader per (group, term) — split-brain detection via
+  the harness's leader_of assert;
+* acknowledged commands survive every fault and appear exactly once;
+* replica files byte-agree on their common prefix at all times and fully
+  at the end;
+* offline WAL diff is clean (log-matching property).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from rafting_tpu.core.types import EngineConfig
+from rafting_tpu.testkit.harness import LocalCluster
+from rafting_tpu.testkit.logcheck import check_logs
+
+CFG = EngineConfig(n_groups=3, n_peers=3, log_slots=64, batch=8,
+                   max_submit=8, election_ticks=10, heartbeat_ticks=3,
+                   rpc_timeout_ticks=8)
+
+
+def test_chaos_partitions_and_crashes(tmp_path):
+    rng = random.Random(1234)
+    c = LocalCluster(CFG, str(tmp_path), seed=5)
+    acked = {g: [] for g in range(CFG.n_groups)}
+    seq = 0
+    down: set = set()
+    try:
+        for g in range(CFG.n_groups):
+            c.wait_leader(g)
+        for round_no in range(60):
+            # -- fault injection every few rounds -------------------------
+            ev = rng.random()
+            if ev < 0.15 and not down:
+                victim = rng.choice(list(c.nodes))
+                c.kill_node(victim)
+                down.add(victim)
+            elif ev < 0.30 and down:
+                v = down.pop()
+                c.restart_node(v)
+            elif ev < 0.45:
+                a = rng.randrange(CFG.n_peers)
+                rest = [n for n in range(CFG.n_peers) if n != a]
+                c.net.partition([[a], rest])
+            elif ev < 0.60:
+                c.net.heal()
+
+            # -- load ------------------------------------------------------
+            for g in range(CFG.n_groups):
+                lead = None
+                try:
+                    lead = c.leader_of(g)
+                except AssertionError:
+                    raise  # split brain: fail loudly
+                if lead is None or lead in down:
+                    continue
+                payload = f"g{g}-s{seq}"
+                seq += 1
+                fut = c.nodes[lead].submit(g, payload.encode())
+                for _ in range(30):
+                    if fut.done():
+                        break
+                    c.tick()
+                if fut.done() and fut.exception() is None:
+                    acked[g].append(payload)
+            c.tick(3)
+
+            # -- continuous prefix-parity oracle ---------------------------
+            if round_no % 10 == 9:
+                for g in range(CFG.n_groups):
+                    c.assert_file_parity(g, require_progress=False)
+
+        # -- convergence ---------------------------------------------------
+        c.net.heal()
+        for v in list(down):
+            c.restart_node(v)
+            down.discard(v)
+        for g in range(CFG.n_groups):
+            c.wait_leader(g)
+        c.tick(80)
+        for g in range(CFG.n_groups):
+            files = {i: c.machine_lines(i, g) for i in c.nodes}
+            lens = {i: len(f) for i, f in files.items()}
+            assert len(set(map(tuple, files.values()))) == 1, \
+                f"group {g} replicas differ at end: lens={lens}"
+            body = [l.split(":", 1)[1].strip() for l in files[0]]
+            for payload in acked[g]:
+                assert body.count(payload) == 1, \
+                    f"acked {payload} appears {body.count(payload)}x"
+    finally:
+        c.close()
+    divs = check_logs([str(tmp_path / f"node{i}" / "wal")
+                       for i in range(CFG.n_peers)])
+    assert divs == [], f"log divergence: {divs[:5]}"
