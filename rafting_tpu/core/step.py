@@ -494,13 +494,13 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     # ---- 10. commit advance ------------------------------------------------
     # Quorum median over the match matrix with self = last (reference
     # Leadership.majorIndices:116-130), gated by the commit-only-own-term
-    # rule (reference Leader.tryCommit:256-261, Raft §5.4.2).
+    # rule (reference Leader.tryCommit:256-261, Raft §5.4.2).  Runs as the
+    # Pallas scan when cfg.use_pallas (ops/quorum.py), else inline jnp —
+    # identical semantics either way.
+    from ..ops.quorum import quorum_commit
     match_full = jnp.where(self_hot, log.last[:, None], match_idx)
-    sorted_m = jnp.sort(match_full, axis=1)
-    quorum_idx = sorted_m[:, P - cfg.majority]
-    can_commit = (active & (role == LEADER) & (quorum_idx > commit) &
-                  (ring_term_at(log, quorum_idx) == term))
-    commit = jnp.where(can_commit, quorum_idx, commit)
+    commit = quorum_commit(cfg, match_full, log, commit, term,
+                           active & (role == LEADER))
     match_idx = match_full
 
     dirty = (term != old_term) | (voted != old_voted) | (log.last != old_last) \

@@ -61,6 +61,8 @@ class EngineConfig:
     rpc_timeout_ticks: int = 8    # re-send an un-acked AppendEntries after this long
                                   #     (reference: per-RPC timeout, Async.java:177-256)
     pre_vote: bool = True         # PreVote phase enabled (reference RaftConfig.java:97-100)
+    use_pallas: bool = False      # quorum-commit via the Pallas TPU kernel
+                                  #     (ops/quorum.py) instead of inline jnp
 
     def __post_init__(self):
         assert self.n_peers >= 1

@@ -1,0 +1,5 @@
+"""Pallas TPU kernels for the engine's hot ops."""
+
+from .quorum import quorum_commit, quorum_commit_pallas, quorum_commit_ref
+
+__all__ = ["quorum_commit", "quorum_commit_pallas", "quorum_commit_ref"]

@@ -54,6 +54,7 @@ from ..snapshot.policy import MaintainAgreement
 from ..transport import InboxAccumulator, messages_template
 from ..transport.codec import pack_slice
 from ..api.anomaly import NotLeaderError, ObsoleteContextError
+from ..utils.metrics import Metrics
 
 log = logging.getLogger(__name__)
 
@@ -138,8 +139,9 @@ class RaftNode:
         self._compact_grant = np.zeros(G, np.int64)
 
         self.ticks = 0
-        self.metrics = {"commits": 0, "applies": 0, "elections": 0,
-                        "snapshots_taken": 0, "snapshots_installed": 0}
+        # Counter/gauge/histogram registry (SURVEY §5: the build must add
+        # commits/sec, election counts, per-step latency histograms).
+        self.metrics = Metrics()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -238,6 +240,7 @@ class RaftNode:
         self.set_active(lane, True)
 
     def tick(self) -> StepInfo:
+        _tick_t0 = time.perf_counter()
         cfg = self.cfg
         G, P = cfg.n_groups, cfg.n_peers
 
@@ -337,6 +340,11 @@ class RaftNode:
         self._snapshot_requests(h_info, h_base)
 
         self.ticks += 1
+        self.metrics.observe("tick_latency_s",
+                             time.perf_counter() - _tick_t0)
+        self.metrics.gauge("groups_active", int(self.h_active.sum()))
+        self.metrics.gauge(
+            "groups_led", int((h_role == LEADER).sum()))
         return h_info
 
     # ---------------------------------------------------------- persistence

@@ -1,0 +1,5 @@
+"""Utilities: metrics/observability for the node runtime."""
+
+from .metrics import Histogram, Metrics
+
+__all__ = ["Metrics", "Histogram"]

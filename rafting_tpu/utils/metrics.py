@@ -1,0 +1,111 @@
+"""Metrics: counters, gauges and latency histograms for the node runtime.
+
+The reference has no metrics beyond logback debug lines and a config block
+feeding the health detector (SURVEY §5; support/RaftConfig.java:137-141) —
+the survey explicitly calls for commits/sec, election counts and per-step
+latency histograms in this build.  This module is dependency-free and
+cheap on the hot path (a counter bump is a dict add; histogram observe is
+a bisect into fixed log-spaced buckets).
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import time
+from typing import Dict, List, Optional
+
+
+class Histogram:
+    """Fixed log-spaced buckets (microseconds to minutes by default)."""
+
+    def __init__(self, bounds: Optional[List[float]] = None):
+        if bounds is None:
+            bounds = [1e-6 * (4 ** i) for i in range(14)]  # 1us .. ~4.5min
+        self.bounds = bounds
+        self.counts = [0] * (len(bounds) + 1)
+        self.total = 0.0
+        self.n = 0
+        self.max = 0.0
+
+    def observe(self, v: float) -> None:
+        self.counts[bisect.bisect_right(self.bounds, v)] += 1
+        self.total += v
+        self.n += 1
+        if v > self.max:
+            self.max = v
+
+    def quantile(self, q: float) -> float:
+        """Upper bucket bound at quantile q (conservative estimate)."""
+        if self.n == 0:
+            return 0.0
+        target = q * self.n
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= target:
+                return self.bounds[i] if i < len(self.bounds) else self.max
+        return self.max
+
+    def summary(self) -> dict:
+        return {
+            "count": self.n,
+            "mean": self.total / self.n if self.n else 0.0,
+            "p50": self.quantile(0.5),
+            "p99": self.quantile(0.99),
+            "max": self.max,
+        }
+
+
+class Metrics:
+    """Counter/gauge/histogram registry with dict-style counter access
+    (``m["commits"] += 1`` and ``m.inc("commits")`` both work)."""
+
+    def __init__(self):
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        self._t0 = time.monotonic()
+
+    # counters ---------------------------------------------------------------
+    def inc(self, name: str, delta: float = 1) -> None:
+        self._counters[name] = self._counters.get(name, 0) + delta
+
+    def __getitem__(self, name: str) -> float:
+        return self._counters.get(name, 0)
+
+    def __setitem__(self, name: str, value: float) -> None:
+        self._counters[name] = value
+
+    # gauges -----------------------------------------------------------------
+    def gauge(self, name: str, value: float) -> None:
+        self._gauges[name] = value
+
+    # histograms -------------------------------------------------------------
+    def histogram(self, name: str) -> Histogram:
+        h = self._histograms.get(name)
+        if h is None:
+            h = self._histograms[name] = Histogram()
+        return h
+
+    def observe(self, name: str, v: float) -> None:
+        self.histogram(name).observe(v)
+
+    # reporting --------------------------------------------------------------
+    def rates(self) -> Dict[str, float]:
+        """Counters divided by registry lifetime (e.g. commits/sec)."""
+        dt = max(time.monotonic() - self._t0, 1e-9)
+        return {f"{k}_per_sec": v / dt for k, v in self._counters.items()}
+
+    def to_dict(self) -> dict:
+        return {
+            "uptime_s": time.monotonic() - self._t0,
+            "counters": dict(self._counters),
+            "gauges": dict(self._gauges),
+            "rates": self.rates(),
+            "histograms": {k: h.summary()
+                           for k, h in self._histograms.items()},
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
