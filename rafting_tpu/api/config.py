@@ -65,6 +65,11 @@ class RaftConfig:
     # peer-health metrics (reference RaftConfig.java:137-141)
     avail_critical_point: int = 3
     recovery_cool_down_ticks: int = 10
+    # submission backpressure (reference EventLoop queue capacity + busy
+    # threshold, support/EventLoop.java:16-17, 136-138)
+    group_queue_cap: int = 512
+    total_queue_cap: int = 500_000
+    busy_threshold: int = 1_000
     # storage layout (reference RaftConfig.java:143-158)
     data_dir: str = "raft-data"
     seed: int = 0
@@ -80,6 +85,14 @@ class RaftConfig:
                              "(reference RaftConfig.java:116-118)")
         if self.tick_ms <= 0:
             raise ValueError("tick_ms must be positive")
+        if self.group_queue_cap < 1:
+            raise ValueError("group_queue_cap must be >= 1")
+        if self.busy_threshold < 0:
+            raise ValueError("busy_threshold must be >= 0")
+        if self.total_queue_cap <= self.busy_threshold:
+            raise ValueError(
+                "total_queue_cap must exceed busy_threshold, or every "
+                "submission would fail with BusyLoopError")
         _parse_uri(self.local)
         for p in self.peers:
             _parse_uri(p)
@@ -104,9 +117,15 @@ class RaftConfig:
     def engine_config(self) -> EngineConfig:
         """Tick-denominated engine shape: wall-clock timing maps onto the
         abstract tick the device engine counts in."""
+        import math
         election_ticks = max(2, round(self.election_mul))
         heartbeat_ticks = max(1, round(self.heartbeat_mul))
-        rpc_timeout = max(1, round(self.election_mul * 2))
+        # broadcast_mul is the reference's per-RPC (broadcast) timeout in
+        # ticks (RaftConfig.broadcastTimeout, support/RaftConfig.java:
+        # 196-198); the engine analog is the un-acked-window resend
+        # deadline.  Floor of 3: a lockstep send->deliver->reply round trip
+        # takes 3 ticks, so a shorter deadline would resend every tick.
+        rpc_timeout = max(3, math.ceil(self.broadcast_mul))
         return EngineConfig(
             n_groups=self.n_groups,
             n_peers=self.cluster_size,
@@ -117,6 +136,8 @@ class RaftConfig:
             heartbeat_ticks=heartbeat_ticks,
             rpc_timeout_ticks=rpc_timeout,
             pre_vote=self.pre_vote,
+            avail_crit=self.avail_critical_point,
+            recovery_ticks=self.recovery_cool_down_ticks,
         )
 
     def maintain(self):
@@ -196,5 +217,8 @@ def load_xml_config(path: str) -> RaftConfig:
         avail_critical_point=attr("metrics", "avail-critical-point", 3, int),
         recovery_cool_down_ticks=attr("metrics", "recovery-cool-down", 10,
                                       int),
+        group_queue_cap=attr("engine", "group-queue-cap", 512, int),
+        total_queue_cap=attr("engine", "total-queue-cap", 500_000, int),
+        busy_threshold=attr("engine", "busy-threshold", 1_000, int),
         data_dir=attr("storage", "dir", "raft-data", str),
     )

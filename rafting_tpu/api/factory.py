@@ -56,4 +56,7 @@ class RaftFactory:
             seed=config.seed,
             maintain=self.maintain(config),
             initial_active=initial_active,
+            group_queue_cap=config.group_queue_cap,
+            total_queue_cap=config.total_queue_cap,
+            busy_threshold=config.busy_threshold,
         )

@@ -136,7 +136,9 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     leader_id, commit = s.leader_id, s.commit
     log = s.log
     next_idx, match_idx = s.next_idx, s.match_idx
-    awaiting, sent_at, need_snap = s.awaiting, s.sent_at, s.need_snap
+    send_next, inflight = s.send_next, s.inflight
+    sent_at, need_snap = s.sent_at, s.need_snap
+    ok_at, fail_at, fail_streak = s.ok_at, s.fail_at, s.fail_streak
     votes, prevotes = s.votes, s.prevotes
     elect_dl, hb_due = s.elect_deadline, s.hb_due
 
@@ -230,13 +232,17 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     vote_win = (role == CANDIDATE) & (votes.sum(axis=1) >= maj)
     # Candidate majority -> Leader (reference Candidate.java:128-131 ->
     # Leader ctor + prepareReplication, Leader.java:25-50): reset the
-    # replication matrix and heartbeat immediately.
+    # replication matrix, health stats and heartbeat immediately.
     role = jnp.where(vote_win, LEADER, role)
     leader_id = jnp.where(vote_win, me, leader_id)
     next_idx = jnp.where(vote_win[:, None], log.last[:, None] + 1, next_idx)
     match_idx = jnp.where(vote_win[:, None], 0, match_idx)
-    awaiting = jnp.where(vote_win[:, None], False, awaiting)
+    send_next = jnp.where(vote_win[:, None], log.last[:, None] + 1, send_next)
+    inflight = jnp.where(vote_win[:, None], 0, inflight)
     need_snap = jnp.where(vote_win[:, None], False, need_snap)
+    ok_at = jnp.where(vote_win[:, None], 0, ok_at)
+    fail_at = jnp.where(vote_win[:, None], 0, fail_at)
+    fail_streak = jnp.where(vote_win[:, None], 0, fail_streak)
     hb_due = jnp.where(vote_win, now, hb_due)
 
     # ---- 4. AppendEntries requests ----------------------------------------
@@ -390,7 +396,18 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     need_snap = jnp.where(aer_r, aer_fail & (nx <= log.base[:, None]),
                           need_snap)
     next_idx = jnp.maximum(nx, log.base[:, None] + 1)
-    awaiting = jnp.where(aer_r, False, awaiting)
+    # Pipeline accounting: each reply acks one in-flight batch; a rejection
+    # aborts the whole window so replication resumes from the clamped
+    # next_idx (reference: nextIndex rollback cancels optimistic sends,
+    # Leadership.updateIndex:75-114).
+    inflight = jnp.where(aer_r, jnp.maximum(inflight - 1, 0), inflight)
+    inflight = jnp.where(aer_fail, 0, inflight)
+    send_next = jnp.where(aer_fail, next_idx, send_next)
+    # Health evidence: any reply — grant or rejection — proves the peer
+    # reachable (reference statSuccess on every response incl. rejects,
+    # Leadership.java:53-63).
+    ok_at = jnp.where(aer_r, now, ok_at)
+    fail_streak = jnp.where(aer_r, 0, fail_streak)
 
     # Snapshot response: success means the follower now covers our offered
     # milestone — resume log replication from just past our floor (reference
@@ -405,7 +422,11 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
                          next_idx)
     match_idx = jnp.where(isr_ok, jnp.maximum(match_idx, log.base[:, None]),
                           match_idx)
-    awaiting = jnp.where(isr_r, False, awaiting)
+    inflight = jnp.where(isr_r, jnp.maximum(inflight - 1, 0), inflight)
+    ok_at = jnp.where(isr_r, now, ok_at)
+    fail_streak = jnp.where(isr_r, 0, fail_streak)
+    # The pipeline head never trails the ack base.
+    send_next = jnp.maximum(send_next, next_idx)
 
     # ---- 7. timers ---------------------------------------------------------
     # (reference RaftRoutine.electionTimeout:65-77 -> Follower.onTimeout:
@@ -448,18 +469,38 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
 
     # ---- 9. replication fan-out -------------------------------------------
     # (reference Leader.replicateLog:142-245 — the hot loop, now a dense
-    # (group x peer) batch build straight from the HBM ring.)
-    heartbeat = (role == LEADER) & (now >= hb_due)
-    n_avail = jnp.clip(log.last[:, None] - next_idx + 1, 0, B)   # [G, P]
-    has_data = (log.last[:, None] >= next_idx) & ~need_snap
-    resend_ok = (~awaiting) | (now - sent_at >= cfg.rpc_timeout_ticks)
+    # (group x peer) batch build straight from the HBM ring, pipelined up to
+    # `inflight_limit` un-acked batches per peer, Leadership.java:10-11.)
     lead_peer = (active & (role == LEADER))[:, None] & ~self_hot
-    send_ae = (lead_peer & ~need_snap & resend_ok &
-               (has_data | heartbeat[:, None]))                  # [G, P]
-    n_send = jnp.where(has_data, n_avail, 0)
-    prev = next_idx - 1
+    # RPC timeout: the window has been un-acked too long.  Failure evidence
+    # for the health stats (reference statFailure on unreachable,
+    # Leadership.java:65-73) + window reset so replication restarts from the
+    # ack base (reference AsyncFuture timeout, Async.java:177-256).
+    timed_out = lead_peer & (inflight > 0) & \
+        (now - sent_at >= cfg.rpc_timeout_ticks)
+    fail_streak = jnp.where(timed_out, fail_streak + 1, fail_streak)
+    fail_at = jnp.where(timed_out, now, fail_at)
+    send_next = jnp.where(timed_out, next_idx, send_next)
+    inflight = jnp.where(timed_out, 0, inflight)
+
+    heartbeat = (role == LEADER) & (now >= hb_due)
+    has_data = (log.last[:, None] >= send_next) & ~need_snap
+    n_avail = jnp.clip(log.last[:, None] - send_next + 1, 0, B)  # [G, P]
+    # Data flows whenever the window has room; empty heartbeat AEs keep
+    # the follower's election timer fed on the normal cadence even while
+    # acks are in flight.  Their prev = send_next - 1 assumes the in-flight
+    # batches arrive first — guaranteed by the transport's per-source
+    # in-order delivery (transport/inbox.py); under loss the follower
+    # rejects and the window resets, same as any failed AE.
+    can_send = inflight < cfg.inflight_limit
+    send_data = lead_peer & ~need_snap & has_data & can_send
+    send_hb = (lead_peer & ~need_snap & heartbeat[:, None] & ~has_data &
+               can_send)
+    send_ae = send_data | send_hb                                # [G, P]
+    n_send = jnp.where(send_data, n_avail, 0)
+    prev = send_next - 1
     # One fused gather for all peers' batches: [G, P*B] -> [P, G, B].
-    flat_idx = (next_idx[:, :, None] + col[None, :, :]).reshape(G, P * B)
+    flat_idx = (send_next[:, :, None] + col[None, :, :]).reshape(G, P * B)
     ents_all = ring_terms_batch(log, flat_idx).reshape(G, P, B)
     prev_terms = ring_terms_batch(log, prev).T                   # [P, G]
     out_ae_valid = send_ae.T
@@ -469,16 +510,32 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     out_ae_commit = jnp.broadcast_to(commit[None, :], (P, G))
     out_ae_n = n_send.T
     out_ae_ents = jnp.swapaxes(ents_all, 0, 1)                   # [P, G, B]
-    # Snapshot offer for laggards (reference Leader.java:168-190).
-    send_is = lead_peer & need_snap & resend_ok
+    # Snapshot offer for laggards (reference Leader.java:168-190); occupies
+    # the whole window (one offer at a time, re-offered after reply/timeout).
+    send_is = lead_peer & need_snap & (inflight == 0)
     out_is_valid = send_is.T
     out_is_term = jnp.broadcast_to(term[None, :], (P, G))
     out_is_idx = jnp.broadcast_to(log.base[None, :], (P, G))
     out_is_last_term = jnp.broadcast_to(log.base_term[None, :], (P, G))
     sent = send_ae | send_is
-    awaiting = jnp.where((send_ae & has_data) | send_is, True, awaiting)
+    send_next = jnp.where(send_data, send_next + n_send, send_next)
+    inflight = jnp.where(sent, inflight + 1, inflight)
     sent_at = jnp.where(sent, now, sent_at)
     hb_due = jnp.where(heartbeat, now + cfg.heartbeat_ticks, hb_due)
+
+    # Leader readiness (reference Leader.isReady, Leader.java:52-64 +
+    # Leadership.isReady/isUnhealthy, Leadership.java:44-51): a follower
+    # counts as healthy once it has replied this leadership (ok_at > 0), is
+    # not mid-snapshot-install, its timeout streak is within the critical
+    # point, and its last failure is outside the recovery cool-down.
+    healthy = (ok_at > 0) & ~need_snap & ~self_hot
+    if cfg.avail_crit > 0:
+        healthy = healthy & (fail_streak <= cfg.avail_crit)
+    if cfg.recovery_ticks > 0:
+        healthy = healthy & ((fail_at == 0) |
+                             (now - fail_at >= cfg.recovery_ticks))
+    ready = (active & (role == LEADER) &
+             (1 + (healthy & lead_peer).sum(axis=1) >= maj))
 
     # Election broadcasts (PreVote at speculative term+1 carrying our log
     # position, reference Follower.prepareElection:223-279; RequestVote at
@@ -510,8 +567,10 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         node_id=s.node_id, now=now, rng=rng, active=active,
         term=term, role=role, voted_for=voted, leader_id=leader_id,
         commit=commit, applied=s.applied, log=log,
-        next_idx=next_idx, match_idx=match_idx, awaiting=awaiting,
-        sent_at=sent_at, need_snap=need_snap, votes=votes, prevotes=prevotes,
+        next_idx=next_idx, match_idx=match_idx, send_next=send_next,
+        inflight=inflight, sent_at=sent_at, need_snap=need_snap,
+        ok_at=ok_at, fail_at=fail_at, fail_streak=fail_streak,
+        votes=votes, prevotes=prevotes,
         elect_deadline=elect_dl, hb_due=hb_due,
     )
     outbox = Messages(
@@ -534,7 +593,7 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     info = StepInfo(
         submit_start=sub_start, submit_acc=n_acc, dirty=dirty,
         appended_from=app_from, appended_to=app_to, log_tail=log.last,
-        commit=commit, leader=leader_id, snap_req=snap_req,
+        commit=commit, leader=leader_id, ready=ready, snap_req=snap_req,
         snap_req_from=snap_from, snap_req_idx=snap_idx_o,
         snap_req_term=snap_term_o,
     )

@@ -63,12 +63,24 @@ class EngineConfig:
     pre_vote: bool = True         # PreVote phase enabled (reference RaftConfig.java:97-100)
     use_pallas: bool = False      # quorum-commit via the Pallas TPU kernel
                                   #     (ops/quorum.py) instead of inline jnp
+    inflight_limit: int = 4       # W — max un-acked AppendEntries batches per
+                                  #     (group, peer) (reference IN_FLIGHT_LIMIT=20,
+                                  #     Leadership.java:11)
+    avail_crit: int = 3           # peer unhealthy after this many consecutive
+                                  #     RPC timeouts (reference availableCriticalPoint,
+                                  #     Leadership.isUnhealthy, Leadership.java:44-47)
+    recovery_ticks: int = 6       # peer stays unhealthy until this long after its
+                                  #     last failure (reference recoveryCoolDownMills,
+                                  #     Leadership.java:45-46)
 
     def __post_init__(self):
         assert self.n_peers >= 1
         assert self.log_slots & (self.log_slots - 1) == 0, "log_slots must be a power of 2"
         assert self.batch <= self.log_slots
         assert self.heartbeat_ticks < self.election_ticks
+        assert self.rpc_timeout_ticks >= 1
+        assert self.inflight_limit >= 1, "pipelining window needs >= 1 slot"
+        assert self.avail_crit >= 0 and self.recovery_ticks >= 0
 
     @property
     def majority(self) -> int:
@@ -117,12 +129,24 @@ class RaftState:
 
     # Leader-side replication bookkeeping (reference Leadership.State,
     # context/member/Leadership.java:30-114).
-    next_idx: jax.Array       # [G, P] int32
+    next_idx: jax.Array       # [G, P] int32 — ack base: first un-ACKed index
     match_idx: jax.Array      # [G, P] int32
-    awaiting: jax.Array       # [G, P] bool — an AppendEntries is in flight
+    send_next: jax.Array      # [G, P] int32 — pipeline head: next index to ship
+                              #   (>= next_idx; the window (next_idx, send_next)
+                              #   is in flight — reference IN_FLIGHT_LIMIT
+                              #   pipelining, Leadership.java:11)
+    inflight: jax.Array       # [G, P] int32 — un-acked AppendEntries batches
     sent_at: jax.Array        # [G, P] int32 — tick of last send (for re-send timeout)
     need_snap: jax.Array      # [G, P] bool — follower fell behind compaction floor
                               #   (reference pendingInstallation, Leadership.java:111-113)
+
+    # Peer-health stats (reference Leadership.State requestSuccess/
+    # requestFailure/recentFailure, Leadership.java:28-73), feeding the
+    # leader readiness gate (Leader.isReady, Leader.java:52-64).
+    ok_at: jax.Array          # [G, P] int32 — tick of last reply since leadership
+                              #   began (0 = never; reference requestSuccess != 0)
+    fail_at: jax.Array        # [G, P] int32 — tick of last RPC timeout (0 = never)
+    fail_streak: jax.Array    # [G, P] int32 — consecutive RPC timeouts
 
     # Election tallies (reference: AtomicInteger vote counts,
     # Candidate.java:112; Follower.prepareElection:241-275).
@@ -255,6 +279,9 @@ class StepInfo:
                               #   must not survive recovery.
     commit: jax.Array         # [G] int32 — post-step commitIndex (apply frontier)
     leader: jax.Array         # [G] int32 — leader hint for client redirect
+    ready: jax.Array          # [G] bool — leading AND a majority of peers healthy
+                              #   (reference Leader.isReady, Leader.java:52-64;
+                              #   the host refuses submissions when False)
     snap_req: jax.Array       # [G] bool — follower should start a snapshot download
     snap_req_from: jax.Array  # [G] int32 — peer to download from
     snap_req_idx: jax.Array   # [G] int32
@@ -269,6 +296,7 @@ class StepInfo:
             dirty=jnp.zeros((G,), jnp.bool_),
             appended_from=z(), appended_to=z(), log_tail=z(),
             commit=z(), leader=jnp.full((G,), NIL, I32),
+            ready=jnp.zeros((G,), jnp.bool_),
             snap_req=jnp.zeros((G,), jnp.bool_),
             snap_req_from=z(), snap_req_idx=z(), snap_req_term=z(),
         )
@@ -303,9 +331,13 @@ def init_state(cfg: EngineConfig, node_id: int, seed: int = 0,
         log=LogState(term=z(G, cfg.log_slots), base=z(G), base_term=z(G), last=z(G)),
         next_idx=jnp.ones((G, P), I32),
         match_idx=z(G, P),
-        awaiting=jnp.zeros((G, P), jnp.bool_),
+        send_next=jnp.ones((G, P), I32),
+        inflight=z(G, P),
         sent_at=z(G, P),
         need_snap=jnp.zeros((G, P), jnp.bool_),
+        ok_at=z(G, P),
+        fail_at=z(G, P),
+        fail_streak=z(G, P),
         votes=jnp.zeros((G, P), jnp.bool_),
         prevotes=jnp.zeros((G, P), jnp.bool_),
         elect_deadline=first_deadline,

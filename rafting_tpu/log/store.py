@@ -183,4 +183,6 @@ def restore_raft_state(cfg, node_id: int, store: LogStore, seed: int = 0):
             base_term=jnp.asarray(base_term), last=jnp.asarray(last)),
         next_idx=jnp.asarray(np.broadcast_to(last[:, None] + 1,
                                              (G, cfg.n_peers)).copy()),
+        send_next=jnp.asarray(np.broadcast_to(last[:, None] + 1,
+                                              (G, cfg.n_peers)).copy()),
     )

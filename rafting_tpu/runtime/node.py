@@ -53,7 +53,9 @@ from ..snapshot.archive import SnapshotArchive
 from ..snapshot.policy import MaintainAgreement
 from ..transport import InboxAccumulator, messages_template
 from ..transport.codec import pack_slice
-from ..api.anomaly import NotLeaderError, ObsoleteContextError
+from ..api.anomaly import (
+    BusyLoopError, NotLeaderError, NotReadyError, ObsoleteContextError,
+)
 from ..utils.metrics import Metrics
 
 log = logging.getLogger(__name__)
@@ -65,7 +67,10 @@ class RaftNode:
                  transport_factory: Callable,
                  seed: int = 0,
                  maintain: Optional[MaintainAgreement] = None,
-                 initial_active: Optional[np.ndarray] = None):
+                 initial_active: Optional[np.ndarray] = None,
+                 group_queue_cap: int = 512,
+                 total_queue_cap: int = 500_000,
+                 busy_threshold: int = 1_000):
         """``transport_factory(node, on_slice, snapshot_provider)`` builds
         the transport endpoint (TcpTransport / LoopbackTransport).
         ``initial_active`` masks which group lanes start open (default all;
@@ -100,7 +105,7 @@ class RaftNode:
         # the tick thread (reference ContextManager create/exit/destroy,
         # context/ContextManager.java:112-167).
         self._lifecycle_lock = threading.Lock()
-        self._lifecycle: List[Tuple[int, bool]] = []
+        self._lifecycle: List[Tuple[int, bool, bool]] = []  # (group, active, purge)
         # Lane incarnations this node has activated: when the admin layer
         # re-allocates a lane to a NEW group (gen bump) and this node missed
         # the destroy (meta-snapshot catch-up), the gen mismatch forces a
@@ -121,10 +126,19 @@ class RaftNode:
         self.h_term = np.asarray(self.state.term).copy()
         self.h_commit = np.asarray(self.state.commit).copy()
         self.h_base = np.asarray(self.state.log.base).copy()
+        # Readiness gate (reference Leader.isReady, Leader.java:52-64): a
+        # fresh leader reports not-ready until a majority of peers reply.
+        self.h_ready = np.zeros(G, bool)
 
-        # Client submissions: group -> FIFO of (payload, Future).
+        # Client submissions: group -> FIFO of (payload, Future), bounded
+        # (reference EventLoop queue capacity + busy threshold,
+        # support/EventLoop.java:16-17, 136-138).
         self._submit_lock = threading.Lock()
         self._submissions: Dict[int, List[Tuple[bytes, Future]]] = {}
+        self._queued_total = 0
+        self.group_queue_cap = group_queue_cap
+        self.total_queue_cap = total_queue_cap
+        self.busy_threshold = busy_threshold   # free slots -> BusyLoopError
 
         # Snapshot downloads: worker threads ONLY fetch bytes to a temp file;
         # every store/dispatcher/archive mutation happens on the tick thread
@@ -172,7 +186,13 @@ class RaftNode:
     def submit(self, group: int, payload: bytes) -> Future:
         """Offer a command to the group's replicated log.  The returned
         future completes with the machine's apply result (reference
-        RaftStub.submit -> Promise, command/RaftStub.java:65-74)."""
+        RaftStub.submit -> Promise, command/RaftStub.java:65-74).
+
+        Refusals mirror the reference's taxonomy: NotLeader (redirect hint),
+        NotReady (leading but a majority of followers unhealthy —
+        Leader.isReady, Leader.java:52-64 -> NotReadyException,
+        RaftStub.java:84-87) and BusyLoop (bounded queues,
+        support/EventLoop.java:136-138)."""
         fut: Future = Future()
         if not self.h_active[group]:
             fut.set_exception(ObsoleteContextError(f"group {group} closed"))
@@ -182,12 +202,29 @@ class RaftNode:
             fut.set_exception(NotLeaderError(
                 group, None if hint == NIL else hint))
             return fut
+        if not self.h_ready[group]:
+            fut.set_exception(NotReadyError(
+                f"group {group}: leader lacks a healthy majority"))
+            return fut
         with self._submit_lock:
-            self._submissions.setdefault(group, []).append((payload, fut))
+            q = self._submissions.setdefault(group, [])
+            if (len(q) >= self.group_queue_cap
+                    or self._queued_total
+                    >= self.total_queue_cap - self.busy_threshold):
+                fut.set_exception(BusyLoopError(
+                    f"group {group}: submission queue full"))
+                return fut
+            q.append((payload, fut))
+            self._queued_total += 1
         return fut
 
     def is_leader(self, group: int) -> bool:
         return bool(self.h_role[group] == LEADER)
+
+    def is_ready(self, group: int) -> bool:
+        """Leading AND a majority of peers healthy (reference
+        Leader.isReady, Leader.java:52-64)."""
+        return bool(self.h_ready[group])
 
     def leader_hint(self, group: int) -> Optional[int]:
         h = int(self.h_leader[group])
@@ -307,6 +344,7 @@ class RaftNode:
         self.h_role, self.h_leader = h_role, h_leader
         self.h_commit, self.h_base = h_commit, h_base
         self.h_term = h_term
+        self.h_ready = np.asarray(h_info.ready)
         self.metrics["elections"] += int(
             ((h_role == LEADER) & (old_role != LEADER)).sum())
         # Leadership lost: abort outstanding client promises BEFORE any
@@ -437,6 +475,7 @@ class RaftNode:
         with self._submit_lock:
             q = self._submissions.get(g, [])
             taken, self._submissions[g] = q[:n], q[n:]
+            self._queued_total -= len(taken)
         for k, (_, fut) in enumerate(taken):
             self.dispatcher.register_promise(g, start_idx + k, fut)
 
@@ -445,6 +484,7 @@ class RaftNode:
         with self._submit_lock:
             q = self._submissions.get(g, [])
             self._submissions[g] = []
+            self._queued_total -= len(q)
         err = exc or NotLeaderError(g, self.leader_hint(g))
         for payload, fut in q:
             if not fut.done():
@@ -480,9 +520,13 @@ class RaftNode:
                 last=s.log.last.at[idx].set(0)),
             next_idx=s.next_idx.at[idx].set(1),
             match_idx=s.match_idx.at[idx].set(0),
-            awaiting=s.awaiting.at[idx].set(False),
+            send_next=s.send_next.at[idx].set(1),
+            inflight=s.inflight.at[idx].set(0),
             sent_at=s.sent_at.at[idx].set(0),
             need_snap=s.need_snap.at[idx].set(False),
+            ok_at=s.ok_at.at[idx].set(0),
+            fail_at=s.fail_at.at[idx].set(0),
+            fail_streak=s.fail_streak.at[idx].set(0),
             votes=s.votes.at[idx].set(False),
             prevotes=s.prevotes.at[idx].set(False),
         )

@@ -97,9 +97,13 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     last = s["log.last"].copy()
     next_idx = s["next_idx"].copy()
     match_idx = s["match_idx"].copy()
-    awaiting = s["awaiting"].copy()
+    send_next = s["send_next"].copy()
+    inflight = s["inflight"].copy()
     sent_at = s["sent_at"].copy()
     need_snap = s["need_snap"].copy()
+    ok_at = s["ok_at"].copy()
+    fail_at = s["fail_at"].copy()
+    fail_streak = s["fail_streak"].copy()
     votes = s["votes"].copy()
     prevotes = s["prevotes"].copy()
     elect_dl = s["elect_deadline"].copy()
@@ -134,6 +138,7 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         "submit_start": zi(G), "submit_acc": zi(G), "dirty": zb(G),
         "appended_from": zi(G), "appended_to": zi(G), "log_tail": zi(G),
         "commit": zi(G), "leader": np.full(G, NIL, np.int32),
+        "ready": zb(G),
         "snap_req": zb(G), "snap_req_from": zi(G), "snap_req_idx": zi(G),
         "snap_req_term": zi(G),
     }
@@ -232,8 +237,12 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
             leader_id[g] = me
             next_idx[g] = log.last + 1
             match_idx[g] = 0
-            awaiting[g] = False
+            send_next[g] = log.last + 1
+            inflight[g] = 0
             need_snap[g] = False
+            ok_at[g] = 0
+            fail_at[g] = 0
+            fail_streak[g] = 0
             hb_due[g] = now
 
         # ---- 4. AppendEntries requests ------------------------------------
@@ -334,7 +343,9 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
             log.base = ct
 
         # ---- 6. AppendEntries / snapshot responses (leader side) ----------
-        # (reference Leader.java:224-243, Leadership.updateIndex:75-114.)
+        # (reference Leader.java:224-243, Leadership.updateIndex:75-114;
+        # pipeline accounting per Leadership.java:10-11; health evidence per
+        # statSuccess, Leadership.java:53-63.)
         for p in range(P):
             r = (bool(ib["aer_valid"][p, g]) and active[g]
                  and role[g] == LEADER and int(ib["aer_term"][p, g]) == term[g])
@@ -347,9 +358,15 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
                 else:
                     next_idx[g, p] = min(max(m + 1, 1), next_idx[g, p])
                     need_snap[g, p] = next_idx[g, p] <= log.base
-                awaiting[g, p] = False
             # Unconditional floor (kernel applies it to every lane).
             next_idx[g, p] = max(next_idx[g, p], log.base + 1)
+            if r:
+                inflight[g, p] = max(inflight[g, p] - 1, 0)
+                if not ib["aer_success"][p, g]:
+                    inflight[g, p] = 0
+                    send_next[g, p] = next_idx[g, p]
+                ok_at[g, p] = now
+                fail_streak[g, p] = 0
             ir = (bool(ib["isr_valid"][p, g]) and active[g]
                   and role[g] == LEADER and int(ib["isr_term"][p, g]) == term[g])
             if ir:
@@ -357,7 +374,11 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
                     need_snap[g, p] = False
                     next_idx[g, p] = max(next_idx[g, p], log.base + 1)
                     match_idx[g, p] = max(match_idx[g, p], log.base)
-                awaiting[g, p] = False
+                inflight[g, p] = max(inflight[g, p] - 1, 0)
+                ok_at[g, p] = now
+                fail_streak[g, p] = 0
+            # The pipeline head never trails the ack base.
+            send_next[g, p] = max(send_next[g, p], next_idx[g, p])
 
         # ---- 7. timers -----------------------------------------------------
         # (reference Follower.onTimeout:156-168, Candidate.onTimeout:82-88.)
@@ -405,22 +426,32 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         info["submit_acc"][g] = n_acc
 
         # ---- 9. replication fan-out ---------------------------------------
-        # (reference Leader.replicateLog:142-245 + prepareElection fan-out.)
+        # (reference Leader.replicateLog:142-245 + prepareElection fan-out;
+        # pipelined up to inflight_limit batches, Leadership.java:10-11.)
         heartbeat = role[g] == LEADER and now >= hb_due[g]
         if active[g] and role[g] == LEADER:
             for p in range(P):
                 if p == me:
                     continue
-                has_data = log.last >= next_idx[g, p] and not need_snap[g, p]
-                resend_ok = (not awaiting[g, p]
-                             or now - sent_at[g, p] >= cfg.rpc_timeout_ticks)
-                send_ae = (not need_snap[g, p] and resend_ok
-                           and (has_data or heartbeat))
-                send_is = need_snap[g, p] and resend_ok
-                if send_ae:
-                    n_send = (min(B, log.last - next_idx[g, p] + 1)
-                              if has_data else 0)
-                    prev = int(next_idx[g, p]) - 1
+                # RPC timeout: reset the window, record failure evidence
+                # (reference statFailure, Leadership.java:65-73).
+                if (inflight[g, p] > 0
+                        and now - sent_at[g, p] >= cfg.rpc_timeout_ticks):
+                    fail_streak[g, p] += 1
+                    fail_at[g, p] = now
+                    send_next[g, p] = next_idx[g, p]
+                    inflight[g, p] = 0
+                has_data = (log.last >= send_next[g, p]
+                            and not need_snap[g, p])
+                can_send = inflight[g, p] < cfg.inflight_limit
+                send_data = not need_snap[g, p] and has_data and can_send
+                send_hb = (not need_snap[g, p] and heartbeat
+                           and not has_data and can_send)
+                send_is = need_snap[g, p] and inflight[g, p] == 0
+                if send_data or send_hb:
+                    n_send = (min(B, log.last - send_next[g, p] + 1)
+                              if send_data else 0)
+                    prev = int(send_next[g, p]) - 1
                     out["ae_valid"][p, g] = True
                     out["ae_term"][p, g] = term[g]
                     out["ae_prev_idx"][p, g] = prev
@@ -431,23 +462,38 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
                     out["ae_commit"][p, g] = commit[g]
                     out["ae_n"][p, g] = n_send
                     for k in range(B):
-                        idx = int(next_idx[g, p]) + k
+                        idx = int(send_next[g, p]) + k
                         out["ae_ents"][p, g, k] = (
                             log.base_term if idx <= log.base
                             else (log.ring[idx % L] if idx <= log.last
                                   else -1))
-                    if has_data:
-                        awaiting[g, p] = True
+                    send_next[g, p] += n_send
                 elif send_is:
                     out["is_valid"][p, g] = True
                     out["is_term"][p, g] = term[g]
                     out["is_idx"][p, g] = log.base
                     out["is_last_term"][p, g] = log.base_term
-                    awaiting[g, p] = True
-                if send_ae or send_is:
+                if send_data or send_hb or send_is:
+                    inflight[g, p] += 1
                     sent_at[g, p] = now
         if heartbeat:
             hb_due[g] = now + cfg.heartbeat_ticks
+
+        # Leader readiness (reference Leader.isReady, Leader.java:52-64).
+        n_healthy = 0
+        for p in range(P):
+            if p == me:
+                continue
+            hp = (active[g] and role[g] == LEADER
+                  and ok_at[g, p] > 0 and not need_snap[g, p])
+            if cfg.avail_crit > 0:
+                hp = hp and fail_streak[g, p] <= cfg.avail_crit
+            if cfg.recovery_ticks > 0:
+                hp = hp and (fail_at[g, p] == 0
+                             or now - fail_at[g, p] >= cfg.recovery_ticks)
+            n_healthy += int(hp)
+        info["ready"][g] = (active[g] and role[g] == LEADER
+                            and 1 + n_healthy >= maj)
         if active[g] and (became_cand or start_pre):
             for p in range(P):
                 if p == me:
@@ -493,7 +539,9 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         "log.term": ring, "log.base": base, "log.base_term": base_term,
         "log.last": last,
         "next_idx": next_idx, "match_idx": match_idx,
-        "awaiting": awaiting, "sent_at": sent_at, "need_snap": need_snap,
+        "send_next": send_next, "inflight": inflight,
+        "sent_at": sent_at, "need_snap": need_snap,
+        "ok_at": ok_at, "fail_at": fail_at, "fail_streak": fail_streak,
         "votes": votes, "prevotes": prevotes,
         "elect_deadline": elect_dl, "hb_due": hb_due,
     }

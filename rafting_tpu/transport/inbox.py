@@ -1,14 +1,28 @@
-"""InboxAccumulator: merges asynchronously arriving peer slices into the
+"""InboxAccumulator: delivers asynchronously arriving peer slices to the
 dense per-tick inbox the engine consumes.
 
 Nodes tick independently; a peer may deliver zero, one or several slices
-between two local ticks.  Per (kind, src, group) the *latest* message wins —
-overwrite-merge.  This is safe for Raft: every RPC is either idempotent or
-re-sent on timeout (the engine's ``awaiting``/``rpc_timeout_ticks`` resend
-path), so dropping a superseded message is indistinguishable from network
-loss, which the protocol already tolerates.  The reference gets the same
-effect from per-request timeouts + stale-reply term fencing
-(transport/rpc/AsyncService.java:120-132, context/member/Leader.java:224-227).
+between two local ticks.  Slices are queued per source and drained **one
+per source per tick, in arrival order** — the engine sees exactly the
+per-tick message planes the sender emitted, just time-shifted.  Ordered
+delivery is what makes the leader's pipelined AppendEntries window sound
+(several un-acked batches in flight per (group, peer), core/step.py
+phase 9): batch k+1's prev-entry check assumes batch k was offered first,
+the same in-order contract the reference gets from one TCP connection per
+peer (transport/EventNode.java:39-120).
+
+Catch-up: a consumer that falls behind (tick-rate drift, a JIT-compile
+stall) must not lag permanently — one-slice-per-tick service can never
+drain a standing backlog under sustained traffic, and stale delivery makes
+every reply look timed out.  When a source's queue exceeds
+COLLAPSE_BACKLOG, the whole backlog is collapsed into one slice,
+newest-wins per (kind, group).  Collapsing reorders nothing the protocol
+can't absorb: replies/votes are idempotent, and a collapsed (= partially
+lost) AppendEntries stream makes the follower reject at the gap, which
+resets the leader's window and resends from the ack base — the engine's
+normal loss recovery (the reference's per-request timeouts + stale-reply
+term fencing, transport/rpc/AsyncService.java:120-132,
+context/member/Leader.java:224-227).
 
 AppendEntries payload bytes ride with their frame and are staged here until
 the engine accepts the entries (StepInfo.appended_from/to), at which point
@@ -18,7 +32,8 @@ the runtime moves them into the durable LogStore.
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional, Tuple
+from collections import deque
+from typing import Deque, Dict, Tuple
 
 import numpy as np
 
@@ -26,65 +41,62 @@ from .codec import KIND_FIELDS
 
 
 class InboxAccumulator:
+    MAX_QUEUED_SLICES = 64   # per source; beyond this, new slices drop
+    COLLAPSE_BACKLOG = 3     # backlog beyond this collapses to one slice
+
     def __init__(self, cfg, template: Dict[str, Tuple[np.dtype, tuple]]):
         self.cfg = cfg
         self.template = template
         self._lock = threading.Lock()
-        P, G = cfg.n_peers, cfg.n_groups
-        self._arrays: Dict[str, np.ndarray] = {
-            name: np.zeros((P, G) + trail, dt)
-            for name, (dt, trail) in template.items()
-        }
-        self._valid_fields = [v for v, _ in KIND_FIELDS.values()]
-        # payload staging: (src, group, index) -> bytes
-        self._payloads: Dict[Tuple[int, int, int], bytes] = {}
-        self._dirty = False
+        # src -> FIFO of (fields, payloads) slices, fields in the sparse
+        # codec.unpack_slice format: field -> (group cols, values).
+        self._queues: Dict[int, Deque[tuple]] = {}
 
     def merge(self, src: int,
               fields: Dict[str, Tuple[np.ndarray, np.ndarray]],
               payloads: Dict[Tuple[int, int], bytes]) -> None:
-        """Merge one unpacked slice from peer ``src`` (codec.unpack_slice)."""
+        """Enqueue one unpacked slice from peer ``src``."""
         with self._lock:
-            for name, (cols, vals) in fields.items():
-                self._arrays[name][src, cols] = vals
-            for (g, idx), p in payloads.items():
-                self._payloads[(src, g, idx)] = p
-            self._dirty = True
-
-    def merge_dense(self, src: int, fields: Dict[str, np.ndarray],
-                    payloads: Dict[Tuple[int, int], bytes]) -> None:
-        """Loopback fast path: merge a full [G]/[G,B] dense slice."""
-        with self._lock:
-            for vfield, dfields in KIND_FIELDS.values():
-                valid = fields[vfield]
-                cols = np.nonzero(valid)[0]
-                if len(cols) == 0:
-                    continue
-                self._arrays[vfield][src, cols] = True
-                for f in dfields:
-                    self._arrays[f][src, cols] = fields[f][cols]
-            for (g, idx), p in payloads.items():
-                self._payloads[(src, g, idx)] = p
-            self._dirty = True
+            q = self._queues.get(src)
+            if q is None:
+                q = self._queues[src] = deque()
+            if len(q) >= self.MAX_QUEUED_SLICES:
+                return   # = network loss; sender's resend timeout recovers
+            q.append((fields, payloads))
 
     def drain(self) -> Tuple[Dict[str, np.ndarray],
                              Dict[Tuple[int, int, int], bytes]]:
-        """Take the accumulated inbox + payload staging, resetting both.
+        """Pop the oldest queued slice of every source and merge them into
+        one dense inbox (different sources occupy disjoint [src, :] rows,
+        so one slice per source never collides).  A source whose backlog
+        exceeds COLLAPSE_BACKLOG has its entire queue collapsed instead
+        (newest wins per lane) so lag stays bounded.
 
-        Returns the live arrays (ownership transfers to the caller) and the
-        staged payloads keyed (src, group, index)."""
+        Returns the dense arrays (ownership transfers to the caller) and
+        the popped slices' payloads keyed (src, group, index)."""
+        P, G = self.cfg.n_peers, self.cfg.n_groups
+        arrays: Dict[str, np.ndarray] = {
+            name: np.zeros((P, G) + trail, dt)
+            for name, (dt, trail) in self.template.items()
+        }
+        payloads: Dict[Tuple[int, int, int], bytes] = {}
         with self._lock:
-            arrays = self._arrays
-            payloads = self._payloads
-            P, G = self.cfg.n_peers, self.cfg.n_groups
-            self._arrays = {
-                name: np.zeros((P, G) + trail, dt)
-                for name, (dt, trail) in self.template.items()
-            }
-            self._payloads = {}
-            self._dirty = False
-            return arrays, payloads
+            for src, q in self._queues.items():
+                if not q:
+                    continue
+                if len(q) > self.COLLAPSE_BACKLOG:
+                    batch, q_new = list(q), deque()
+                    self._queues[src] = q_new
+                else:
+                    batch = [q.popleft()]
+                for fields, pl in batch:
+                    for name, (cols, vals) in fields.items():
+                        arrays[name][src, cols] = vals
+                    for (g, idx), p in pl.items():
+                        payloads[(src, g, idx)] = p
+        return arrays, payloads
 
     @property
     def has_traffic(self) -> bool:
-        return self._dirty
+        with self._lock:
+            return any(self._queues.values())

@@ -1,0 +1,128 @@
+"""Peer-health gate, submission backpressure and AE pipelining.
+
+Covers the reference behaviors added in round 2:
+
+* Leader readiness gate: a leader whose majority of followers is
+  unreachable refuses new commands with NotReadyError instead of letting
+  them time out (reference Leader.isReady, context/member/Leader.java:52-64;
+  Leadership.isUnhealthy health stats, Leadership.java:44-73;
+  NotReadyException via RaftStub.java:84-87), and recovers after heal.
+* Bounded submission queues: flooding one group trips BusyLoopError while
+  other groups keep making progress (reference EventLoop queue capacity +
+  busy threshold, support/EventLoop.java:16-17, 136-138).
+* Replication pipelining: allowing several un-acked AppendEntries batches
+  per (group, peer) raises per-group commit throughput (reference
+  IN_FLIGHT_LIMIT pipelining, Leadership.java:10-11, Leader.java:162-195).
+"""
+
+import numpy as np
+import pytest
+
+from rafting_tpu.api.anomaly import BusyLoopError, NotReadyError
+from rafting_tpu.core.cluster import DeviceCluster
+from rafting_tpu.core.types import EngineConfig, LEADER
+from rafting_tpu.testkit.harness import LocalCluster
+
+CFG = EngineConfig(n_groups=4, n_peers=3, log_slots=32, batch=4,
+                   max_submit=4, election_ticks=10, heartbeat_ticks=3,
+                   rpc_timeout_ticks=5, avail_crit=2, recovery_ticks=4)
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = LocalCluster(CFG, str(tmp_path))
+    yield c
+    c.close()
+
+
+def test_not_ready_under_partition_and_recovery(cluster):
+    c = cluster
+    lead = c.wait_leader(0)
+    c.submit_via_leader(0, b"before")
+
+    # Cut the leader off from both followers: every AE window times out,
+    # fail_streak crosses avail_crit, and the readiness gate must close
+    # while the node still believes it leads (it sees no higher term).
+    c.net.partition([[lead], [i for i in c.nodes if i != lead]])
+    c.tick_until(
+        lambda: c.nodes[lead].h_role[0] == LEADER
+        and not c.nodes[lead].is_ready(0),
+        200, "leader readiness gate to close")
+
+    fut = c.nodes[lead].submit(0, b"during-partition")
+    assert isinstance(fut.exception(timeout=1), NotReadyError)
+
+    # Heal: the stale leader either steps down to the majority-side leader
+    # (higher term) or regains follower health; either way the cluster
+    # accepts commands again and the gate reopens on the real leader.
+    c.net.heal()
+    c.submit_via_leader(0, b"after-heal")
+    new_lead = c.leader_of(0)
+    assert c.nodes[new_lead].is_ready(0)
+
+
+def test_fresh_leader_not_ready_until_replies(cluster):
+    c = cluster
+    lead = c.wait_leader(0)
+    # Once replies flow, the gate opens (requestSuccess != 0 analog).
+    c.tick_until(lambda: c.nodes[lead].is_ready(0), 50, "readiness")
+    assert c.nodes[lead].is_ready(0)
+
+
+def test_busy_loop_backpressure(cluster):
+    c = cluster
+    lead = c.wait_leader(0)
+    c.tick_until(lambda: c.nodes[lead].is_ready(0), 50, "readiness")
+    node = c.nodes[lead]
+    node.group_queue_cap = 6  # shrink the bound to keep the test fast
+
+    # Flood group 0 without ticking: the queue cannot drain, so the cap
+    # must trip.  Other groups still accept (per-group bounds).
+    futs = [node.submit(0, f"flood-{k}".encode()) for k in range(6)]
+    overflow = node.submit(0, b"overflow")
+    assert isinstance(overflow.exception(timeout=1), BusyLoopError)
+
+    lead1 = c.wait_leader(1)
+    c.tick_until(lambda: c.nodes[lead1].is_ready(1), 50, "g1 readiness")
+    ok = c.nodes[lead1].submit(1, b"other-group")
+    # Drain everything: queued floods and the other group's command commit.
+    c.tick_until(lambda: all(f.done() for f in futs) and ok.done(), 300,
+                 "flood drain")
+    assert ok.exception() is None
+    assert all(f.exception() is None for f in futs)
+    assert node._queued_total == 0
+
+
+def test_total_queue_cap(cluster):
+    c = cluster
+    lead = c.wait_leader(0)
+    c.tick_until(lambda: c.nodes[lead].is_ready(0), 50, "readiness")
+    node = c.nodes[lead]
+    node.total_queue_cap = node.busy_threshold + 2  # 2 free slots total
+    a = node.submit(0, b"a")
+    b = node.submit(0, b"b")
+    full = node.submit(0, b"c")
+    assert isinstance(full.exception(timeout=1), BusyLoopError)
+    c.tick_until(lambda: a.done() and b.done(), 200, "drain")
+    assert a.exception() is None and b.exception() is None
+
+
+def _commits_after(cfg: EngineConfig, ticks: int) -> int:
+    c = DeviceCluster(cfg, seed=0)
+    c.run(ticks, submit_n=cfg.max_submit)
+    return int(np.asarray(c.states.commit).max(axis=0).astype(np.int64).sum())
+
+
+def test_pipelining_raises_throughput():
+    base = dict(n_groups=8, n_peers=3, log_slots=64, batch=8, max_submit=8,
+                election_ticks=10, heartbeat_ticks=3, rpc_timeout_ticks=8,
+                pre_vote=True)
+    ticks = 80
+    serial = _commits_after(EngineConfig(**base, inflight_limit=1), ticks)
+    piped = _commits_after(EngineConfig(**base, inflight_limit=4), ticks)
+    assert serial > 0
+    # A 4-deep window must beat the one-batch-per-RTT serial engine by a
+    # wide margin (it sends every tick instead of every round trip; the
+    # piped engine saturates the submit rate, so the ratio is bounded by
+    # submit_rate / serial_rate ≈ 2.1 here).
+    assert piped >= 1.8 * serial, (serial, piped)

@@ -98,10 +98,12 @@ def test_frame_reader_partial_and_crc():
         codec.FrameReader().feed(bytes(bad))
 
 
-def test_inbox_overwrite_merge():
+def test_inbox_fifo_per_source():
     tmpl = messages_template(CFG)
     acc = InboxAccumulator(CFG, tmpl)
-    # Two successive AE slices from src 1 for group 2: latest wins.
+    # Two successive AE slices from src 1 for group 2: delivered one per
+    # drain, oldest first (ordered delivery is what keeps the pipelined
+    # AppendEntries window sound — see transport/inbox.py module doc).
     for term in (7, 8):
         f = _dense_fields(CFG.n_groups, CFG.batch)
         f["ae_term"][2] = term
@@ -110,11 +112,49 @@ def test_inbox_overwrite_merge():
         src, fields, payloads = codec.unpack_slice(body, tmpl)
         acc.merge(src, fields, payloads)
     arrays, payloads = acc.drain()
-    assert arrays["ae_valid"][1, 2] and arrays["ae_term"][1, 2] == 8
+    assert arrays["ae_valid"][1, 2] and arrays["ae_term"][1, 2] == 7
+    assert acc.has_traffic   # second slice still queued
+    arrays2, _ = acc.drain()
+    assert arrays2["ae_valid"][1, 2] and arrays2["ae_term"][1, 2] == 8
     assert not acc.has_traffic
     # post-drain: clean slate
-    arrays2, _ = acc.drain()
-    assert not arrays2["ae_valid"].any()
+    arrays3, _ = acc.drain()
+    assert not arrays3["ae_valid"].any()
+
+
+def _feed_ae_slices(acc, tmpl, terms):
+    for term in terms:
+        f = _dense_fields(CFG.n_groups, CFG.batch)
+        f["ae_term"][2] = term
+        packed = codec.pack_slice(1, f, lambda g, i: b"x")
+        _, body = codec.FrameReader().feed(packed)[0]
+        src, fields, payloads = codec.unpack_slice(body, tmpl)
+        acc.merge(src, fields, payloads)
+
+
+def test_inbox_backlog_collapse():
+    """A backlog beyond COLLAPSE_BACKLOG is collapsed to one slice
+    (newest wins) so a lagging consumer catches up instead of serving
+    stale traffic forever."""
+    tmpl = messages_template(CFG)
+    acc = InboxAccumulator(CFG, tmpl)
+    k = InboxAccumulator.COLLAPSE_BACKLOG
+    _feed_ae_slices(acc, tmpl, range(1, k + 2))   # k+1 queued > threshold
+    arrays, _ = acc.drain()
+    assert int(arrays["ae_term"][1, 2]) == k + 1  # newest won
+    assert not acc.has_traffic                    # backlog fully consumed
+
+
+def test_inbox_overflow_drops_newest():
+    tmpl = messages_template(CFG)
+    acc = InboxAccumulator(CFG, tmpl)
+    cap = InboxAccumulator.MAX_QUEUED_SLICES
+    _feed_ae_slices(acc, tmpl, range(1, cap + 3))  # 2 beyond the bound
+    arrays, _ = acc.drain()
+    # Overflow slices (cap+1, cap+2) were dropped at merge; the collapse
+    # delivers the newest retained slice.
+    assert int(arrays["ae_term"][1, 2]) == cap
+    assert not acc.has_traffic
 
 
 def _free_ports(n):
