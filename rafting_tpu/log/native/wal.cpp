@@ -460,6 +460,34 @@ int64_t wal_entry_payload(void* h, uint32_t group, uint64_t index,
 uint64_t wal_group_count(void* h) { return ((Wal*)h)->groups.size(); }
 uint64_t wal_segment_count(void* h) { return ((Wal*)h)->live_segs.size(); }
 
+// On-disk footprint of all live segments + the unflushed buffer.  Stats the
+// files (cheap at GC-policy cadence) so the figure survives restart.
+uint64_t wal_total_bytes(void* h) {
+  Wal* w = (Wal*)h;
+  uint64_t total = w->buf.size();
+  struct stat st;
+  for (uint32_t id : w->live_segs)
+    if (::stat(seg_path(*w, id).c_str(), &st) == 0)
+      total += (uint64_t)st.st_size;
+  return total;
+}
+
+// Bytes a checkpoint rewrite would carry: live entries (frame + body + payload)
+// plus per-group stable/milestone records.  total_bytes / live_bytes is the
+// GC trigger ratio (the dead fraction — entries superseded by overwrite,
+// truncation, compaction or reset — is what GC reclaims).
+uint64_t wal_live_bytes(void* h) {
+  Wal* w = (Wal*)h;
+  uint64_t live = 0;
+  for (auto& kv : w->groups) {
+    const GroupState& gs = kv.second;
+    if (gs.has_stable) live += 12 + 21;
+    if (gs.floor > 0) live += 12 + 21;
+    for (auto& er : gs.entries) live += 12 + 25 + er.second.len;
+  }
+  return live;
+}
+
 // List group ids into caller buffer; returns count written.
 uint64_t wal_groups(void* h, uint32_t* out, uint64_t cap) {
   Wal* w = (Wal*)h;

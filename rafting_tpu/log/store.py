@@ -97,6 +97,27 @@ class LogStore:
         """Rewrite live state, dropping dead segments (GC)."""
         self.wal.checkpoint()
 
+    def maybe_gc(self, ratio: float = 4.0, min_bytes: int = 8 << 20) -> bool:
+        """Run the GC checkpoint when the dead fraction warrants it: disk
+        footprint exceeds ``min_bytes`` AND ``ratio`` x the live set (the
+        reference reclaims continuously via RocksDB deleteRange,
+        RocksLog.java:228-242; a segmented WAL reclaims by rewriting the
+        live set, so it must be amortized).  The rewrite cost is bounded by
+        the live bytes — compaction keeps per-group live windows small, so
+        the occasional on-tick-thread pass stays short while the trigger
+        ratio bounds disk at ~ratio x live."""
+        total = self.wal.total_bytes()
+        if total < min_bytes:
+            return False
+        live = self.wal.live_bytes()
+        if total > ratio * max(live, 1):
+            self.wal.checkpoint()
+            return True
+        return False
+
+    def segment_count(self) -> int:
+        return int(self.wal.segment_count())
+
     # -- reads ---------------------------------------------------------------
 
     def payload(self, g: int, idx: int) -> Optional[bytes]:

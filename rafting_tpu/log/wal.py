@@ -88,6 +88,10 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.wal_checkpoint.restype = ctypes.c_int
         lib.wal_segment_count.argtypes = [ctypes.c_void_p]
         lib.wal_segment_count.restype = ctypes.c_uint64
+        lib.wal_total_bytes.argtypes = [ctypes.c_void_p]
+        lib.wal_total_bytes.restype = ctypes.c_uint64
+        lib.wal_live_bytes.argtypes = [ctypes.c_void_p]
+        lib.wal_live_bytes.restype = ctypes.c_uint64
         _lib = lib
         return lib
 
@@ -164,6 +168,12 @@ class _NativeWal:
 
     def segment_count(self):
         return int(self._lib.wal_segment_count(self._h))
+
+    def total_bytes(self):
+        return int(self._lib.wal_total_bytes(self._h))
+
+    def live_bytes(self):
+        return int(self._lib.wal_live_bytes(self._h))
 
 
 _MAGIC = 0x52574131
@@ -358,6 +368,27 @@ class PyWal:
 
     def segment_count(self):
         return len(self._segs)
+
+    def total_bytes(self):
+        total = len(self._buf) + self._f.tell()
+        for sid in self._segs[:-1]:
+            try:
+                total += os.path.getsize(self._seg_path(sid))
+            except OSError:
+                pass
+        return total
+
+    def live_bytes(self):
+        # Mirrors the native accounting: frame (12) + record body sizes.
+        live = 0
+        for gs in self.groups.values():
+            if gs.stable is not None:
+                live += 12 + 21
+            if gs.floor > 0:
+                live += 12 + 21
+            for term, payload in gs.entries.values():
+                live += 12 + 25 + len(payload)
+        return live
 
     def close(self):
         self._flush()

@@ -152,6 +152,13 @@ class RaftNode:
         # Compaction grants computed at the end of tick t, applied in t+1.
         self._compact_grant = np.zeros(G, np.int64)
 
+        # WAL GC cadence/thresholds (VERDICT r1 #5: milestones advance the
+        # logical floor, but disk is only reclaimed by the checkpoint
+        # rewrite — trigger it when the dead fraction justifies the cost).
+        self.wal_gc_check_ticks = 128
+        self.wal_gc_ratio = 4.0
+        self.wal_gc_min_bytes = 8 << 20
+
         self.ticks = 0
         # Counter/gauge/histogram registry (SURVEY §5: the build must add
         # commits/sec, election counts, per-step latency histograms).
@@ -591,22 +598,30 @@ class RaftNode:
                 pass
         self._compact_grant = self.maintain.compact_targets(
             now, self.h_commit.astype(np.int64), h_base.astype(np.int64))
+        # Physical WAL GC (amortized; see LogStore.maybe_gc).
+        if self.wal_gc_check_ticks and now % self.wal_gc_check_ticks == 0:
+            try:
+                if self.store.maybe_gc(self.wal_gc_ratio,
+                                       self.wal_gc_min_bytes):
+                    self.metrics["wal_gc_runs"] += 1
+                self.metrics.gauge("wal_segments",
+                                   self.store.segment_count())
+            except Exception:
+                log.exception("WAL GC failed")
 
     # -------------------------------------------------------------- snapshot
 
     def _serve_snapshot(self, group: int, index: int, term: int
-                        ) -> Optional[Tuple[int, int, bytes]]:
+                        ) -> Optional[Tuple[int, int, str]]:
         """Transport callback: serve our newest snapshot for the group
         (reference EventBus WaitSnap -> TransSnap + sendfile,
-        transport/EventBus.java:98-111)."""
+        transport/EventBus.java:98-111).  Returns (index, term, path); the
+        transport streams the file in chunks, so snapshot size is
+        unbounded by the frame codec's MAX_BODY."""
         snap = self.archive.last_snapshot(group)
-        if snap is None:
+        if snap is None or not os.path.exists(snap.path):
             return None
-        try:
-            with open(snap.path, "rb") as f:
-                return snap.index, snap.term, f.read()
-        except OSError:
-            return None
+        return snap.index, snap.term, snap.path
 
     def _snapshot_requests(self, info: StepInfo, h_base) -> None:
         req = np.nonzero(np.asarray(info.snap_req))[0]
@@ -634,21 +649,27 @@ class RaftNode:
         SnapChannel download, transport/EventNode.java:122-267).  Install —
         every store/dispatcher/archive mutation — happens on the tick
         thread in ``_install_snapshots``."""
+        tmp = os.path.join(self.data_dir, f"snap-recv-g{g}.tmp")
+        ok = False
         try:
-            res = self.transport.fetch_snapshot(peer, g, idx, term)
+            res = self.transport.fetch_snapshot(peer, g, idx, term, tmp)
             if res is None or self._stop.is_set():
                 self.archive.fail_pending(g)
                 return
-            got_idx, got_term, payload = res
-            tmp = os.path.join(self.data_dir, f"snap-recv-g{g}.tmp")
-            with open(tmp, "wb") as f:
-                f.write(payload)
+            got_idx, got_term = res
             with self._snap_lock:
                 self._snap_fetched.append((g, got_idx, got_term, tmp))
+            ok = True
         except Exception:
             log.exception("snapshot fetch failed g=%d", g)
             self.archive.fail_pending(g)
         finally:
+            if not ok:
+                # Every failure path drops the partial download.
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
             self._snap_inflight.discard(g)
 
     def _install_snapshots(self, fetched) -> List[Tuple[int, int, int]]:

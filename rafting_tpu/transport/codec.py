@@ -18,9 +18,15 @@ Types:
     MSGS      — one tick slice (see ``pack_slice``)
     SNAP_REQ  — snapshot fetch request: (group, index, term)
                 (reference WaitSnapEvent, transport/event/WaitSnapEvent.java:8-38)
-    SNAP_DATA — snapshot response: (group, index, term, ok, payload)
-                (reference TransSnapEvent + raw transfer,
-                transport/event/TransSnapEvent.java:8-64)
+    SNAP_HDR  — snapshot response header: (group, index, term, ok, total_len)
+                (reference TransSnapEvent, transport/event/TransSnapEvent.java:8-64)
+    SNAP_CHUNK— one chunk of snapshot bytes; `total_len` bytes follow the
+                header across N chunks, written to disk incrementally on
+                the receiving side.  Chunking is what frees snapshot bulk
+                from the 64MB MAX_BODY frame cap — the reference achieves
+                the same by streaming the file raw outside its codec
+                (DefaultFileRegion sendfile, transport/EventBus.java:98-111,
+                "transparent mode" in EventCodec.java:282-290).
 """
 
 from __future__ import annotations
@@ -32,9 +38,11 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 MAGIC = 0x54505552  # "RUPT"
-HELLO, MSGS, SNAP_REQ, SNAP_DATA, FWD_REQ, FWD_RESP = 1, 2, 3, 4, 5, 6
+(HELLO, MSGS, SNAP_REQ, SNAP_HDR, FWD_REQ, FWD_RESP,
+ SNAP_CHUNK) = 1, 2, 3, 4, 5, 6, 7
 
 MAX_BODY = 64 << 20  # 64 MB cap, matching the reference (EventCodec.java:26)
+SNAP_CHUNK_BYTES = 1 << 20  # snapshot streaming chunk size
 
 _HDR = struct.Struct("<IBII")
 
@@ -56,7 +64,8 @@ KIND_BY_ID = {i: k for k, i in KIND_IDS.items()}
 
 
 def frame(ftype: int, body: bytes) -> bytes:
-    assert len(body) <= MAX_BODY
+    if len(body) > MAX_BODY:
+        raise IOError(f"frame body {len(body)} exceeds MAX_BODY {MAX_BODY}")
     return _HDR.pack(MAGIC, ftype, len(body), zlib.crc32(body)) + body
 
 
@@ -140,15 +149,20 @@ def serve_forward(submit_handler: Optional[Callable], group: int,
         return False, f"{type(e).__name__}: {e}".encode()
 
 
-def pack_snap_data(group: int, index: int, term: int, ok: bool,
-                   payload: bytes) -> bytes:
-    head = struct.pack("<IQqB", group, index, term, 1 if ok else 0)
-    return frame(SNAP_DATA, head + payload)
+def pack_snap_hdr(group: int, index: int, term: int, ok: bool,
+                  total_len: int) -> bytes:
+    return frame(SNAP_HDR,
+                 struct.pack("<IQqBQ", group, index, term,
+                             1 if ok else 0, total_len))
 
 
-def unpack_snap_data(body: bytes) -> Tuple[int, int, int, bool, bytes]:
-    group, index, term, ok = struct.unpack_from("<IQqB", body, 0)
-    return group, index, term, bool(ok), body[struct.calcsize("<IQqB"):]
+def unpack_snap_hdr(body: bytes) -> Tuple[int, int, int, bool, int]:
+    group, index, term, ok, total_len = struct.unpack("<IQqBQ", body)
+    return group, index, term, bool(ok), total_len
+
+
+def pack_snap_chunk(data: bytes) -> bytes:
+    return frame(SNAP_CHUNK, data)
 
 
 def pack_slice(src: int, fields: Dict[str, np.ndarray],
@@ -216,17 +230,32 @@ def unpack_slice(body: bytes, template: Dict[str, Tuple[np.dtype, tuple]],
     {(group, index): payload}).  ``n_groups`` bounds-checks column ids so a
     corrupt or shape-mismatched frame can't scatter out of range.
     """
+    end = len(body)
+
+    def need(n: int, off: int) -> None:
+        # A CRC-valid but semantically malformed frame (buggy or hostile
+        # peer) must fail as a clean IOError — the reader treats it as a
+        # connection drop — never as silent truncation or a stray
+        # struct.error that kills the reader thread.
+        if off + n > end:
+            raise IOError("truncated MSGS body (malformed frame)")
+
+    need(struct.calcsize("<IB"), 0)
     src, n_kinds = struct.unpack_from("<IB", body, 0)
     off = struct.calcsize("<IB")
     out: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
     payloads: Dict[Tuple[int, int], bytes] = {}
     for _ in range(n_kinds):
+        need(struct.calcsize("<BI"), off)
         kid, n_cols = struct.unpack_from("<BI", body, off)
         off += struct.calcsize("<BI")
+        if kid not in KIND_BY_ID:
+            raise IOError(f"unknown message kind id {kid}")
         kind = KIND_BY_ID[kid]
         vfield, dfields = KIND_FIELDS[kind]
         if n_cols == 0:
             continue
+        need(4 * n_cols, off)
         cols = np.frombuffer(body, np.uint32, n_cols, off).astype(np.int64)
         if n_groups is not None and cols.size and int(cols.max()) >= n_groups:
             raise IOError("column id out of range (shape mismatch?)")
@@ -236,6 +265,7 @@ def unpack_slice(body: bytes, template: Dict[str, Tuple[np.dtype, tuple]],
             dt, trail = template[f]
             count = n_cols * int(np.prod(trail, dtype=np.int64)) \
                 if trail else n_cols
+            need(count * np.dtype(dt).itemsize, off)
             vals = np.frombuffer(body, dt, count, off).reshape(
                 (n_cols,) + trail)
             off += vals.nbytes
@@ -245,8 +275,10 @@ def unpack_slice(body: bytes, template: Dict[str, Tuple[np.dtype, tuple]],
             ns = out["ae_n"][1]
             for g, prev, n in zip(cols.tolist(), prevs.tolist(), ns.tolist()):
                 for idx in range(int(prev) + 1, int(prev) + 1 + int(n)):
+                    need(4, off)
                     (plen,) = struct.unpack_from("<I", body, off)
                     off += 4
+                    need(plen, off)
                     payloads[(int(g), idx)] = body[off:off + plen]
                     off += plen
     return src, out, payloads

@@ -90,8 +90,10 @@ class LoopbackTransport:
         return codec.serve_forward(t.submit_handler, group, payload, timeout)
 
     def fetch_snapshot(self, peer: int, group: int, index: int, term: int,
-                       timeout: float = 60.0
-                       ) -> Optional[Tuple[int, int, bytes]]:
+                       dest_path: str, timeout: float = 60.0
+                       ) -> Optional[Tuple[int, int]]:
+        """File-to-file snapshot copy (the loopback analog of the TCP
+        chunk stream): bytes never accumulate in memory."""
         if not self.net._up(self.node_id, peer) or \
                 not self.net._up(peer, self.node_id):
             return None
@@ -101,5 +103,10 @@ class LoopbackTransport:
         res = t.snapshot_provider(group, index, term)
         if res is None:
             return None
-        idx, tm, payload = res
-        return idx, tm, payload
+        idx, tm, path = res
+        try:
+            import shutil
+            shutil.copyfile(path, dest_path)
+        except OSError:
+            return None
+        return idx, tm

@@ -22,6 +22,7 @@ the analog of the reference's busy-loop backpressure hint
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import socket
 import struct
@@ -175,26 +176,48 @@ class TcpTransport:
         self._senders[dst].send(packed)
 
     def fetch_snapshot(self, peer: int, group: int, index: int, term: int,
-                       timeout: float = 60.0
-                       ) -> Optional[Tuple[int, int, bytes]]:
-        """Ephemeral snapshot fetch (reference SnapChannel).  Blocking —
-        call from a worker thread.  Returns (index, term, payload) or None."""
+                       dest_path: str, timeout: float = 60.0
+                       ) -> Optional[Tuple[int, int]]:
+        """Ephemeral snapshot fetch (reference SnapChannel,
+        transport/EventNode.java:122-267).  Streams the snapshot into
+        ``dest_path`` chunk by chunk — bytes never accumulate in memory
+        and no single frame exceeds MAX_BODY, so snapshots of any size
+        install.  Blocking — call from a worker thread.  Returns
+        (index, term) or None."""
         try:
             with socket.create_connection(self.peers[peer],
                                           timeout=timeout) as sock:
                 sock.settimeout(timeout)
                 sock.sendall(codec.pack_snap_req(group, index, term))
                 reader = codec.FrameReader()
-                while True:
-                    data = sock.recv(1 << 20)
-                    if not data:
-                        return None
-                    for ftype, body in reader.feed(data):
-                        if ftype == codec.SNAP_DATA:
-                            g, idx, tm, ok, payload = \
-                                codec.unpack_snap_data(body)
-                            return (idx, tm, payload) if ok else None
-        except OSError as e:
+                meta = None          # (idx, term, total_len)
+                received = 0
+                f = None
+                try:
+                    while True:
+                        data = sock.recv(1 << 20)
+                        if not data:
+                            return None
+                        for ftype, body in reader.feed(data):
+                            if ftype == codec.SNAP_HDR:
+                                g, idx, tm, ok, total = \
+                                    codec.unpack_snap_hdr(body)
+                                if not ok:
+                                    return None
+                                meta = (idx, tm, total)
+                                f = open(dest_path, "wb")
+                            elif ftype == codec.SNAP_CHUNK and f is not None:
+                                f.write(body)
+                                received += len(body)
+                        if meta is not None and received >= meta[2]:
+                            f.close()
+                            f = None
+                            return meta[0], meta[1]
+                finally:
+                    if f is not None:
+                        f.close()
+        except (OSError, IOError, ValueError, struct.error, KeyError) as e:
+            # Malformed frames / unknown peer fail like any transport error.
             log.debug("snapshot fetch from %d failed: %s", peer, e)
             return None
 
@@ -255,7 +278,9 @@ class TcpTransport:
                     elif ftype == codec.FWD_REQ:
                         self._serve_forward(conn, body)
                         return  # ephemeral: one command, then close
-        except (OSError, IOError):
+        except (OSError, IOError, ValueError, struct.error):
+            # Malformed frames (struct/ValueError from a buggy or hostile
+            # peer) end the connection cleanly, same as transport errors.
             pass
         finally:
             try:
@@ -291,13 +316,31 @@ class TcpTransport:
         conn.sendall(codec.pack_fwd_resp(ok, res))
 
     def _serve_snapshot(self, conn: socket.socket, body: bytes):
+        """Stream our snapshot file in bounded chunks (reference zero-copy
+        sendfile serve, transport/EventBus.java:98-111).  The provider
+        returns (index, term, path); the file is read incrementally so
+        serving never loads the whole snapshot into memory."""
         group, index, term = codec.unpack_snap_req(body)
-        if self.snapshot_provider is None:
-            conn.sendall(codec.pack_snap_data(group, index, term, False, b""))
-            return
-        res = self.snapshot_provider(group, index, term)
+        # The read loop's 1s poll timeout is wrong for a bulk send: a >1s
+        # receiver stall would abort the stream mid-transfer.  Give the
+        # serve its own generous deadline.
+        conn.settimeout(60.0)
+        res = (self.snapshot_provider(group, index, term)
+               if self.snapshot_provider is not None else None)
         if res is None:
-            conn.sendall(codec.pack_snap_data(group, index, term, False, b""))
-        else:
-            idx, tm, payload = res
-            conn.sendall(codec.pack_snap_data(group, idx, tm, True, payload))
+            conn.sendall(codec.pack_snap_hdr(group, index, term, False, 0))
+            return
+        idx, tm, path = res
+        try:
+            total = os.path.getsize(path)
+            with open(path, "rb") as f:
+                conn.sendall(codec.pack_snap_hdr(group, idx, tm, True, total))
+                while True:
+                    chunk = f.read(codec.SNAP_CHUNK_BYTES)
+                    if not chunk:
+                        break
+                    conn.sendall(codec.pack_snap_chunk(chunk))
+        except OSError:
+            # File vanished (e.g. retention rotated it): the client's
+            # byte-count check fails and it re-requests.
+            log.debug("snapshot serve failed g=%d", group)

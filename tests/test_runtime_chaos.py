@@ -101,3 +101,43 @@ def test_chaos_partitions_and_crashes(tmp_path):
     divs = check_logs([str(tmp_path / f"node{i}" / "wal")
                        for i in range(CFG.n_peers)])
     assert divs == [], f"log divergence: {divs[:5]}"
+
+
+def test_wal_gc_bounds_disk_in_runtime(tmp_path):
+    """Long-running load with aggressive snapshot/compaction cadence: the
+    node's maintain phase must trigger WAL GC so disk stays bounded while
+    floors advance (VERDICT r1 #5)."""
+    from rafting_tpu.snapshot.policy import MaintainAgreement
+
+    cfg = EngineConfig(n_groups=2, n_peers=3, log_slots=32, batch=4,
+                       max_submit=4, election_ticks=10, heartbeat_ticks=3,
+                       rpc_timeout_ticks=8)
+    c = LocalCluster(
+        cfg, str(tmp_path), seed=3,
+        maintain_factory=lambda: MaintainAgreement(
+            cfg.n_groups, state_change_threshold=4, dirty_log_tolerance=2,
+            snap_min_interval=4, compact_min_interval=2, compact_slack=4))
+    try:
+        for node in c.nodes.values():
+            node.wal_gc_check_ticks = 16
+            node.wal_gc_ratio = 2.0
+            node.wal_gc_min_bytes = 1 << 12
+        lead = c.wait_leader(0)
+        payload = b"z" * 512
+        for k in range(120):
+            c.submit_via_leader(k % cfg.n_groups, payload)
+        c.tick(40)   # drain applies, snapshots, compaction, GC
+        gc_runs = sum(n.metrics["wal_gc_runs"] for n in c.nodes.values())
+        assert gc_runs > 0, "no node ever ran WAL GC under churn"
+        for n in c.nodes.values():
+            # Disk stays within the GC trigger envelope: the next check
+            # would fire at 2 x live, so the footprint can never exceed
+            # that by more than one check interval's writes (~bounded by
+            # the load between checks; 256KB is generous here).
+            total = n.store.wal.total_bytes()
+            live = n.store.wal.live_bytes()
+            assert total <= 2.0 * max(live, 1) + (256 << 10), (total, live)
+            # Floors advanced (compaction actually ran) on every node.
+            assert any(n.store.floor(g) > 0 for g in range(cfg.n_groups))
+    finally:
+        c.close()

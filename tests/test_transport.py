@@ -1,6 +1,7 @@
 """Transport plane tests: codec round-trip, inbox merge semantics, real TCP
 delivery and the ephemeral snapshot channel."""
 
+import os
 import threading
 import time
 
@@ -169,12 +170,16 @@ def _free_ports(n):
     return ports
 
 
-def test_tcp_delivery_and_snapshot():
+def test_tcp_delivery_and_snapshot(tmp_path):
     p0, p1 = _free_ports(2)
     peers = {0: ("127.0.0.1", p0), 1: ("127.0.0.1", p1)}
 
+    blob = b"SNAPDATA" * 100
+    src_file = tmp_path / "snap-src"
+    src_file.write_bytes(blob)
+
     def provider(group, index, term):
-        return 10, 3, b"SNAPDATA" * 100
+        return 10, 3, str(src_file)
 
     ts = {}
     cfg2 = EngineConfig(n_groups=8, n_peers=2, log_slots=16, batch=4,
@@ -198,9 +203,51 @@ def test_tcp_delivery_and_snapshot():
             time.sleep(0.05)
         arrays, _ = accs[1].drain()
         assert arrays["rv_valid"][0, 3] and arrays["rv_term"][0, 3] == 5
-        # snapshot side channel
-        res = ts[0].fetch_snapshot(1, group=3, index=10, term=3, timeout=10)
-        assert res == (10, 3, b"SNAPDATA" * 100)
+        # snapshot side channel (streamed to a file)
+        dest = str(tmp_path / "snap-dest")
+        res = ts[0].fetch_snapshot(1, group=3, index=10, term=3,
+                                   dest_path=dest, timeout=10)
+        assert res == (10, 3)
+        assert open(dest, "rb").read() == blob
+    finally:
+        ts[0].close()
+        ts[1].close()
+
+
+def test_tcp_snapshot_larger_than_max_body(tmp_path):
+    """A snapshot bigger than the frame codec's 64MB MAX_BODY must stream
+    through chunking (the reference's raw sendfile side channel frees it
+    from the codec cap the same way, EventBus.java:98-111)."""
+    p0, p1 = _free_ports(2)
+    peers = {0: ("127.0.0.1", p0), 1: ("127.0.0.1", p1)}
+
+    total = codec.MAX_BODY + (1 << 20)       # 65 MB
+    src_file = tmp_path / "big-snap"
+    with open(src_file, "wb") as f:
+        f.seek(total - 1)
+        f.write(b"\x7f")                     # sparse on disk, full on wire
+
+    def provider(group, index, term):
+        return 99, 4, str(src_file)
+
+    cfg2 = EngineConfig(n_groups=8, n_peers=2, log_slots=16, batch=4,
+                        max_submit=4)
+    tmpl2 = messages_template(cfg2)
+    ts = {}
+    for i in (0, 1):
+        ts[i] = TcpTransport(i, dict(peers), cfg2, tmpl2,
+                             on_slice=lambda *a: None,
+                             snapshot_provider=provider)
+        ts[i].start()
+    try:
+        dest = str(tmp_path / "big-dest")
+        res = ts[0].fetch_snapshot(1, group=0, index=99, term=4,
+                                   dest_path=dest, timeout=60)
+        assert res == (99, 4)
+        assert os.path.getsize(dest) == total
+        with open(dest, "rb") as f:
+            f.seek(total - 1)
+            assert f.read(1) == b"\x7f"
     finally:
         ts[0].close()
         ts[1].close()

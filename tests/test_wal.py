@@ -219,3 +219,61 @@ def test_restore_raft_state(tmp_path):
     assert ring[0, 3 % 16] == 2
     assert ring[1, 5 % 16] == 1
     s.close()
+
+
+def test_gc_bounds_disk_while_floors_advance(tmp_path, backend):
+    """Live-path GC (VERDICT r1 #5): under a sustained append + compact
+    workload, maybe_gc keeps segment count and disk footprint bounded
+    while the logical floor advances (the reference reclaims space with
+    RocksDB deleteRange, RocksLog.java:228-242)."""
+    store = LogStore(str(tmp_path / "wal"), segment_bytes=64 << 10,
+                     force_python=(backend == "python"))
+    payload = b"p" * 256
+    max_segs = 0
+    gc_runs = 0
+    idx = 0
+    for round_ in range(40):
+        for _ in range(20):
+            idx += 1
+            store.append_entries(5, idx, [1], [payload])
+        store.put_stable(5, round_ + 1, 0)
+        # Keep a short live window: everything but the last 8 compacted.
+        if idx > 8:
+            store.set_floor(5, idx - 8, 1)
+        store.sync()
+        if store.maybe_gc(ratio=2.0, min_bytes=64 << 10):
+            gc_runs += 1
+        max_segs = max(max_segs, store.segment_count())
+    assert gc_runs >= 1, "GC never triggered under a churning workload"
+    # Disk stays within the trigger envelope instead of growing forever:
+    # ~40 rounds x 20 entries x ~300B would be ~240KB+ without GC.
+    assert store.wal.total_bytes() <= 4 * max(store.wal.live_bytes(), 1) \
+        + (64 << 10)
+    assert store.segment_count() <= 4
+    # Live state survives the rewrites.
+    assert store.tail(5) == idx
+    assert store.floor(5) == idx - 8
+    assert store.payload(5, idx) == payload
+    store.close()
+
+
+def test_gc_cross_engine_recovery(tmp_path):
+    """A GC checkpoint written by one engine recovers on the other (the
+    rewrite emits the same record format)."""
+    if not native_available():
+        pytest.skip("no native engine")
+    store = LogStore(str(tmp_path / "wal"), segment_bytes=64 << 10,
+                     force_python=False)
+    for i in range(1, 41):
+        store.append_entries(2, i, [3], [b"x" * 64])
+    store.set_floor(2, 30, 3)
+    store.put_stable(2, 9, 1)
+    store.sync()
+    store.checkpoint()
+    store.close()
+    w = PyWal(str(tmp_path / "wal"))
+    assert w.tail(2) == 40
+    assert w.floor(2) == 30
+    assert w.stable(2) == (9, 1)
+    assert w.entry_payload(2, 35) == b"x" * 64
+    w.close()
