@@ -499,6 +499,56 @@ uint64_t wal_groups(void* h, uint32_t* out, uint64_t cap) {
   return n;
 }
 
+// Bulk state export for boot-time restore (the vectorized analog of the
+// reference's per-group RaftContext.initialize restore walk,
+// context/RaftContext.java:91-113): one call fills per-group arrays for
+// groups [0, G) plus the [G, L] ring of live entry terms (slot = idx % L
+// for idx in (floor, tail]).  live_count[g] lets the caller verify
+// contiguity (expected = tail - floor) without a per-entry Python loop.
+uint64_t wal_export_state(void* h, uint32_t G, uint32_t L,
+                          int64_t* stable_term, int64_t* ballot,
+                          uint8_t* has_stable, int64_t* floor_out,
+                          int64_t* floor_term, int64_t* tail,
+                          int64_t* live_count, int32_t* ring) {
+  Wal* w = (Wal*)h;
+  uint64_t n = 0;
+  for (auto& kv : w->groups) {
+    uint32_t g = kv.first;
+    if (g >= G) continue;
+    GroupState& gs = kv.second;
+    stable_term[g] = gs.stable_term;
+    ballot[g] = gs.ballot;
+    has_stable[g] = gs.has_stable ? 1 : 0;
+    floor_out[g] = gs.floor;
+    floor_term[g] = gs.floor_term;
+    tail[g] = gs.tail;
+    int64_t cnt = 0;
+    for (auto& er : gs.entries) {
+      int64_t idx = (int64_t)er.first;
+      if (idx > gs.floor && idx <= gs.tail) {
+        if (ring) ring[(uint64_t)g * L + (er.first % L)] =
+            (int32_t)er.second.term;
+        cnt++;
+      }
+    }
+    live_count[g] = cnt;
+    n++;
+  }
+  return n;
+}
+
+// Batched append: n entries across any mix of groups in ONE call, payload
+// bytes concatenated in `payloads` at offsets `offs` (the host runtime
+// stages a whole tick's writes and crosses the ctypes boundary once).
+void wal_append_entries(void* h, uint64_t n, const uint32_t* groups,
+                        const uint64_t* idxs, const int64_t* terms,
+                        const uint8_t* payloads, const uint64_t* offs,
+                        const uint32_t* lens) {
+  for (uint64_t i = 0; i < n; i++)
+    wal_append_entry(h, groups[i], idxs[i], terms[i],
+                     payloads + offs[i], lens[i]);
+}
+
 // Rewrite all live state into a fresh segment and delete older segments —
 // the compaction/GC pass (the reference's RocksDB deleteRange + snapshot
 // retention analog, RocksLog.java:228-242).

@@ -53,6 +53,19 @@ class LogStore:
         self._durable_tail[g] = max(self._durable_tail.get(g, 0),
                                     start + len(terms) - 1)
 
+    def append_batch(self, groups: Sequence[int], idxs: Sequence[int],
+                     terms: Sequence[int], payloads: Sequence[bytes]) -> None:
+        """Stage a whole tick's appends across all groups in one engine
+        call (native: one ctypes crossing; the batching analog of the
+        reference's group-commit WAL flush, RocksLog flushWal after a
+        batch, command/storage/RocksLog.java:87,195)."""
+        self.wal.append_batch(groups, idxs, terms, payloads)
+        for g, i, p in zip(groups, idxs, payloads):
+            g, i = int(g), int(i)
+            self._cache[(g, i)] = p
+            if i > self._durable_tail.get(g, 0):
+                self._durable_tail[g] = i
+
     def truncate_to(self, g: int, tail: int) -> None:
         """Ensure the durable suffix beyond `tail` dies (conflict/snapshot
         discard).  No-op if the durable tail is already <= tail."""
@@ -171,31 +184,33 @@ def restore_raft_state(cfg, node_id: int, store: LogStore, seed: int = 0):
 
     state = init_state(cfg, node_id, seed=seed)
     G, L = cfg.n_groups, cfg.log_slots
-    term = np.zeros(G, np.int32)
-    voted = np.full(G, NIL, np.int32)
-    base = np.zeros(G, np.int32)
-    base_term = np.zeros(G, np.int32)
-    last = np.zeros(G, np.int32)
-    commit = np.zeros(G, np.int32)
-    ring = np.zeros((G, L), np.int32)
-    for g in range(G):
-        st = store.stable(g)
-        if st is not None:
-            term[g], voted[g] = st
-        floor = store.floor(g)
-        base[g] = floor
-        base_term[g] = store.floor_term(g)
-        tail = store.tail(g)
-        last[g] = max(tail, floor)
-        commit[g] = floor
-        for idx in range(floor + 1, last[g] + 1):
+    # One bulk export call instead of an O(G*L) Python walk (VERDICT r1
+    # #8); the native engine fills every per-group array + the term ring
+    # in C (wal_export_state).
+    ex = store.wal.export_state(G, L)
+    term = np.where(ex["has_stable"] > 0, ex["stable_term"], 0) \
+        .astype(np.int32)
+    voted = np.where(ex["has_stable"] > 0, ex["ballot"], NIL) \
+        .astype(np.int32)
+    base = ex["floor"].astype(np.int32)
+    base_term = ex["floor_term"].astype(np.int32)
+    last = np.maximum(ex["tail"], ex["floor"]).astype(np.int32)
+    commit = ex["floor"].astype(np.int32)
+    ring = ex["ring"]
+    # Contiguity check without a per-entry walk: live_count must equal the
+    # window size.  A gap above the floor (inconsistent WAL) falls back to
+    # the slow scan for just that group.
+    expected = (last.astype(np.int64) - base.astype(np.int64))
+    suspect = np.nonzero(ex["live_count"] != expected)[0]
+    for g in suspect.tolist():
+        ring[g] = 0
+        last[g] = base[g]
+        for idx in range(int(base[g]) + 1, int(ex["tail"][g]) + 1):
             t = store.entry_term(g, idx)
             if t < 0:
-                # Gap above the floor (shouldn't happen with a consistent
-                # WAL): fall back to the contiguous prefix.
-                last[g] = idx - 1
                 break
             ring[g, idx % L] = t
+            last[g] = idx
     return state.replace(
         term=jnp.asarray(term), voted_for=jnp.asarray(voted),
         commit=jnp.asarray(commit),

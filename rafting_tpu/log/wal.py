@@ -92,6 +92,16 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.wal_total_bytes.restype = ctypes.c_uint64
         lib.wal_live_bytes.argtypes = [ctypes.c_void_p]
         lib.wal_live_bytes.restype = ctypes.c_uint64
+        lib.wal_export_state.restype = ctypes.c_uint64
+        lib.wal_export_state.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p]
+        lib.wal_append_entries.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.c_void_p, ctypes.c_void_p]
         _lib = lib
         return lib
 
@@ -175,9 +185,58 @@ class _NativeWal:
     def live_bytes(self):
         return int(self._lib.wal_live_bytes(self._h))
 
+    def export_state(self, G: int, L: int) -> dict:
+        """Bulk boot-time restore: one native call fills all per-group
+        arrays + the [G, L] entry-term ring (wal_export_state)."""
+        out = _export_arrays(G, L)
+        ptr = lambda a: a.ctypes.data_as(ctypes.c_void_p)
+        self._lib.wal_export_state(
+            self._h, G, L, ptr(out["stable_term"]), ptr(out["ballot"]),
+            ptr(out["has_stable"]), ptr(out["floor"]),
+            ptr(out["floor_term"]), ptr(out["tail"]),
+            ptr(out["live_count"]), ptr(out["ring"]))
+        return out
+
+    def append_batch(self, groups, idxs, terms, payloads) -> None:
+        """Append many (group, idx, term, payload) records in one native
+        call: payload bytes are concatenated host-side so the ctypes
+        boundary is crossed once per tick, not once per entry."""
+        import numpy as np
+        n = len(groups)
+        if n == 0:
+            return
+        lens = np.fromiter((len(p) for p in payloads), np.uint32, n)
+        offs = np.zeros(n, np.uint64)
+        offs[1:] = np.cumsum(lens[:-1], dtype=np.uint64)
+        blob = b"".join(payloads)
+        g_arr = np.asarray(groups, np.uint32)
+        i_arr = np.asarray(idxs, np.uint64)
+        t_arr = np.asarray(terms, np.int64)
+        ptr = lambda a: a.ctypes.data_as(ctypes.c_void_p)
+        self._lib.wal_append_entries(
+            self._h, n, ptr(g_arr), ptr(i_arr), ptr(t_arr), blob,
+            ptr(offs), ptr(lens))
+
 
 _MAGIC = 0x52574131
 _ENTRY, _STABLE, _TRUNCATE, _MILESTONE, _RESET = 1, 2, 3, 4, 5
+
+
+def _export_arrays(G: int, L: int) -> dict:
+    """The shared export_state output schema — ONE definition so the two
+    engines (and restore_raft_state, which depends on the exact defaults,
+    e.g. ballot=-1 masked by has_stable) cannot drift."""
+    import numpy as np
+    return {
+        "stable_term": np.zeros(G, np.int64),
+        "ballot": np.full(G, -1, np.int64),
+        "has_stable": np.zeros(G, np.uint8),
+        "floor": np.zeros(G, np.int64),
+        "floor_term": np.zeros(G, np.int64),
+        "tail": np.zeros(G, np.int64),
+        "live_count": np.zeros(G, np.int64),
+        "ring": np.zeros((G, L), np.int32),
+    }
 
 
 class _PyGroup:
@@ -368,6 +427,31 @@ class PyWal:
 
     def segment_count(self):
         return len(self._segs)
+
+    def export_state(self, G: int, L: int) -> dict:
+        """Bulk boot-time restore (same contract as the native engine's
+        wal_export_state; loops only over live groups)."""
+        out = _export_arrays(G, L)
+        for g, gs in self.groups.items():
+            if g >= G:
+                continue
+            if gs.stable is not None:
+                out["stable_term"][g], out["ballot"][g] = gs.stable
+                out["has_stable"][g] = 1
+            out["floor"][g] = gs.floor
+            out["floor_term"][g] = gs.floor_term
+            out["tail"][g] = gs.tail
+            cnt = 0
+            for idx, (term, _) in gs.entries.items():
+                if gs.floor < idx <= gs.tail:
+                    out["ring"][g, idx % L] = term
+                    cnt += 1
+            out["live_count"][g] = cnt
+        return out
+
+    def append_batch(self, groups, idxs, terms, payloads) -> None:
+        for g, i, t, p in zip(groups, idxs, terms, payloads):
+            self.append_entry(int(g), int(i), int(t), p)
 
     def total_bytes(self):
         total = len(self._buf) + self._f.tell()

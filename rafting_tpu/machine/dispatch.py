@@ -41,11 +41,29 @@ class ApplyDispatcher:
         self._promises: Dict[tuple, Future] = {}
         self._on_applied = on_applied
         self._retry_counts: Dict[tuple, int] = {}
+        # Numpy mirror of every machine's last_applied: advance() visits
+        # only lanes whose commit frontier moved past it, so per-tick cost
+        # scales with progress, not with total group count (VERDICT r1 #8).
+        # Lazily sized from the first commit array; always <= the machine's
+        # true last_applied is the invariant that makes skipping safe.
+        self._applied_arr: Optional[np.ndarray] = None
+
+    def _applied_mirror(self, n: int) -> np.ndarray:
+        a = self._applied_arr
+        if a is None or len(a) < n:
+            a = np.zeros(n, np.int64)
+            for g, m in self._machines.items():
+                if g < n:
+                    a[g] = m.last_applied()
+            self._applied_arr = a
+        return a
 
     def machine(self, g: int) -> RaftMachine:
         m = self._machines.get(g)
         if m is None:
             m = self._machines[g] = self._provider.bootstrap(g)
+            if self._applied_arr is not None and g < len(self._applied_arr):
+                self._applied_arr[g] = m.last_applied()
         return m
 
     def applied(self, g: int) -> int:
@@ -83,6 +101,8 @@ class ApplyDispatcher:
         if m is not None:
             (m.destroy if destroy else m.close)()
         self._halted.pop(g, None)
+        if self._applied_arr is not None and g < len(self._applied_arr):
+            self._applied_arr[g] = 0
         for key in [k for k in self._retry_counts if k[0] == g]:
             del self._retry_counts[key]
 
@@ -94,6 +114,8 @@ class ApplyDispatcher:
         commands committed cluster-wide but the result is unobservable here.
         """
         self.machine(g).recover(checkpoint)
+        if self._applied_arr is not None and g < len(self._applied_arr):
+            self._applied_arr[g] = self.machine(g).last_applied()
         for key in [k for k in self._promises
                     if k[0] == g and k[1] <= checkpoint.index]:
             f = self._promises.pop(key)
@@ -110,10 +132,12 @@ class ApplyDispatcher:
         """Apply newly committed entries.  `commit` is the [G] frontier;
         `groups` optionally restricts which lanes are live (active mask or
         index list).  `max_per_group` bounds work per call (0 = no bound)."""
+        mirror = self._applied_mirror(len(commit))
+        behind = commit > mirror[:len(commit)]
         if groups is None:
-            gs = np.nonzero(commit > 0)[0]
+            gs = np.nonzero(behind)[0]
         elif groups.dtype == bool:
-            gs = np.nonzero(groups & (commit > 0))[0]
+            gs = np.nonzero(groups & behind)[0]
         else:
             gs = groups
         for g in gs:
@@ -153,13 +177,21 @@ class ApplyDispatcher:
                 if fut is not None and not fut.done():
                     fut.set_result(result)
                 idx += 1
+            # Mirror tracks true machine progress; on a payload gap or a
+            # failed apply it simply stays behind and the lane is revisited
+            # next tick.
+            mirror[g] = idx - 1 if idx - 1 > before else before
             if self._on_applied is not None and idx - 1 > before:
                 self._on_applied(g, idx - 1)
 
     def applied_frontier(self, n_groups: int) -> np.ndarray:
         out = np.zeros(n_groups, np.int32)
+        a = self._applied_arr
+        if a is not None and len(a) >= n_groups:
+            return a[:n_groups].astype(np.int32)
         for g, m in self._machines.items():
-            out[g] = m.last_applied()
+            if g < n_groups:
+                out[g] = m.last_applied()
         return out
 
     def close(self) -> None:

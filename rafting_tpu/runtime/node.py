@@ -126,6 +126,9 @@ class RaftNode:
         self.h_term = np.asarray(self.state.term).copy()
         self.h_commit = np.asarray(self.state.commit).copy()
         self.h_base = np.asarray(self.state.log.base).copy()
+        # Floors already pushed to the WAL (mirror, avoids per-group floor
+        # queries every tick).
+        self._wal_floor = self.h_base.astype(np.int64).copy()
         # Readiness gate (reference Leader.isReady, Leader.java:52-64): a
         # fresh leader reports not-ready until a majority of peers reply.
         self.h_ready = np.zeros(G, bool)
@@ -411,19 +414,24 @@ class RaftNode:
             self.store.put_stable(g, int(h_term[g]), int(h_voted[g]))
             any_write = True
 
-        # Entries appended/overwritten this tick.
+        # Entries appended/overwritten this tick: stage ALL groups' writes
+        # into one batch, crossing into the WAL engine once (VERDICT r1 #8
+        # — the per-group per-entry Python loop was the scaling wall).
         wrote = np.nonzero(app_to > 0)[0]
+        bat_g: List[int] = []
+        bat_i: List[int] = []
+        bat_t: List[int] = []
+        bat_p: List[bytes] = []
+        commits: List[Tuple[int, int, int]] = []
         for g in wrote.tolist():
             lo, hi = int(app_from[g]), int(app_to[g])
             n_sub = int(sub_acc[g])
             sub_lo = int(sub_start[g])
             leader_src = int(h_leader[g])
-            terms, payloads, idxs = [], [], []
             for idx in range(lo, hi + 1):
                 if n_sub and idx >= sub_lo:
                     # our own accepted submission: payload from the queue
-                    k = idx - sub_lo
-                    payload = self._take_submission(g, k)
+                    payload = self._take_submission(g, idx - sub_lo)
                     term = int(h_term[g])
                 else:
                     # follower adoption: payload staged with the leader's
@@ -437,24 +445,28 @@ class RaftNode:
                         # Stop at the gap: the durable prefix stays
                         # contiguous; resend will re-deliver.
                         break
-                idxs.append(idx)
-                terms.append(term)
-                payloads.append(payload)
-            if idxs:
-                self.store.append_entries(g, idxs[0], terms, payloads)
-                any_write = True
+                bat_g.append(g)
+                bat_i.append(idx)
+                bat_t.append(term)
+                bat_p.append(payload)
+            commits.append((g, sub_lo, n_sub))
+        if bat_g:
+            self.store.append_batch(bat_g, bat_i, bat_t, bat_p)
+            any_write = True
+        for g, sub_lo, n_sub in commits:
             self._commit_submissions(g, sub_lo, n_sub)
 
         # Truncations: durable tail must not exceed the device tail.
         for g in dirty.tolist():
             self.store.truncate_to(g, int(log_tail[g]))
 
-        # WAL floor follows the device compaction floor.
+        # WAL floor follows the device compaction floor; the pushed-floor
+        # mirror keeps this loop over only the groups that moved.
         wal_floors_moved = False
-        for g in np.nonzero(h_base > 0)[0].tolist():
-            if int(h_base[g]) > self.store.floor(g):
-                self.store.set_floor(g, int(h_base[g]), int(h_base_term[g]))
-                wal_floors_moved = True
+        for g in np.nonzero(h_base > self._wal_floor)[0].tolist():
+            self.store.set_floor(g, int(h_base[g]), int(h_base_term[g]))
+            self._wal_floor[g] = h_base[g]
+            wal_floors_moved = True
 
         if any_write or wal_floors_moved:
             self.store.sync()   # THE durability barrier
@@ -543,6 +555,7 @@ class RaftNode:
         hc[np.asarray(lanes)] = 0
         hb[np.asarray(lanes)] = 0
         self.h_commit, self.h_base = hc, hb
+        self._wal_floor[np.asarray(lanes)] = 0
 
     @staticmethod
     def _staged_term(arrays, src: int, g: int, idx: int) -> Optional[int]:
@@ -694,6 +707,7 @@ class RaftNode:
                 # Durable milestone before the device adopts it (the stable-
                 # record rule for snapshots, support/StableLock.java:82-91).
                 self.store.set_floor(g, snap.index, snap.term)
+                self._wal_floor[g] = max(self._wal_floor[g], snap.index)
                 self.store.sync()
                 self.maintain.note_checkpoint(g, self.ticks, snap.index)
                 self.metrics["snapshots_installed"] += 1
