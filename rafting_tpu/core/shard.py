@@ -1,0 +1,99 @@
+"""Explicit multi-chip sharding specs for the stacked cluster pytrees.
+
+A whole N-node cluster stacks every per-node pytree along a leading ``node``
+axis (core/cluster.py), and each node's state is group-major.  Under a
+``Mesh('node', 'group')`` the natural layout is therefore fixed by *meaning*,
+not by array sizes: the specs below are declared per field, so a group count
+that happens to collide with another dimension (P, L, B, S) can never change
+the sharding (the failure mode of size-based inference).
+
+The reference has no analog — its "mesh" is one JVM per node and a TCP mesh
+between them (transport/NettyCluster.java:42-50); here the node axis is a
+real device-mesh axis and the inter-node ``route()`` transpose lowers to an
+XLA all-to-all over it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
+
+from .types import EngineConfig, LogState, Messages, RaftState, StepInfo
+
+# RaftState fields with no group axis: per-node scalars and the PRNG key.
+_STATE_NODE_ONLY = ("node_id", "now", "rng")
+
+_NODE = PS("node")
+_NODE_GROUP = PS("node", "group")          # [N, G, ...] — trailing dims replicated
+_NODE_PEER_GROUP = PS("node", None, "group")  # [N, P, G, ...] message planes
+
+
+def state_pspecs() -> RaftState:
+    """A RaftState-shaped pytree of PartitionSpecs for stacked [N, ...] state."""
+    kw = {f.name: _NODE_GROUP for f in dataclasses.fields(RaftState)}
+    for name in _STATE_NODE_ONLY:
+        kw[name] = _NODE
+    kw["log"] = LogState(term=_NODE_GROUP, base=_NODE_GROUP,
+                         base_term=_NODE_GROUP, last=_NODE_GROUP)
+    return RaftState(**kw)
+
+
+def messages_pspecs() -> Messages:
+    """Specs for stacked [N, P, G, ...] message planes (axis 2 = group)."""
+    return Messages(**{f.name: _NODE_PEER_GROUP
+                       for f in dataclasses.fields(Messages)})
+
+
+def info_pspecs() -> StepInfo:
+    return StepInfo(**{f.name: _NODE_GROUP
+                       for f in dataclasses.fields(StepInfo)})
+
+
+# Non-pytree cluster inputs.
+CONN_PSPEC = PS("node")        # [N, N] connectivity — rows ride the node axis
+SUBMIT_PSPEC = PS("node", "group")  # [N, G] offered load
+
+
+def validate_cluster_shapes(cfg: EngineConfig, states: RaftState,
+                            inflight: Messages, info: StepInfo,
+                            conn: jax.Array | None = None,
+                            submit: jax.Array | None = None) -> None:
+    """Assert the declared group axes actually hold G — the guard that makes
+    the per-field specs safe regardless of dimension-size collisions."""
+    G, P = cfg.n_groups, cfg.n_peers
+    N = states.term.shape[0]
+    assert states.term.ndim == 2 and states.term.shape[1] == G, states.term.shape
+    assert states.next_idx.shape[1:] == (G, P), states.next_idx.shape
+    assert states.log.term.shape[1] == G, states.log.term.shape
+    assert inflight.ae_valid.ndim == 3 and inflight.ae_valid.shape[2] == G, \
+        inflight.ae_valid.shape
+    assert info.commit.shape[1] == G, info.commit.shape
+    if conn is not None:
+        assert conn.shape == (N, N), conn.shape
+    if submit is not None:
+        assert submit.shape == (N, G), submit.shape
+
+
+def shard_cluster(mesh: Mesh, cfg: EngineConfig, states: RaftState,
+                  inflight: Messages, info: StepInfo, conn: jax.Array,
+                  submit: jax.Array) -> Tuple[RaftState, Messages, StepInfo,
+                                              jax.Array, jax.Array]:
+    """device_put every cluster input with its explicit per-field spec."""
+    validate_cluster_shapes(cfg, states, inflight, info, conn, submit)
+
+    def put(tree, specs):
+        # The arrays tree leads: specs are flattened only up to its
+        # structure, so each PartitionSpec stays atomic at a leaf position.
+        return jax.tree.map(
+            lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+            tree, specs)
+
+    states = put(states, state_pspecs())
+    inflight = put(inflight, messages_pspecs())
+    info = put(info, info_pspecs())
+    conn = jax.device_put(conn, NamedSharding(mesh, CONN_PSPEC))
+    submit = jax.device_put(submit, NamedSharding(mesh, SUBMIT_PSPEC))
+    return states, inflight, info, conn, submit
