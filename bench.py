@@ -50,8 +50,9 @@ def child_run(n_groups: int, measure_ticks: int, warmup_ticks: int,
         jax.config.update("jax_platforms", platform)
     import jax.numpy as jnp
     import numpy as np
+    from functools import partial as _partial
     from rafting_tpu import DeviceCluster, EngineConfig
-    from rafting_tpu.core.sim import run_cluster_ticks
+    from rafting_tpu.core.sim import run_cluster_ticks, run_cluster_ticks_blocked
 
     t_init = time.perf_counter()
     dev = jax.devices()[0]
@@ -64,12 +65,24 @@ def child_run(n_groups: int, measure_ticks: int, warmup_ticks: int,
         election_ticks=10, heartbeat_ticks=3, rpc_timeout_ticks=8,
         pre_vote=True,
     )
+    # Group-axis tiling: one fused program is proven to 32k groups on TPU
+    # and faults at >= 65k (r1), so larger runs tile the group axis into
+    # equal blocks <= BENCH_GROUP_BLOCK, each running the whole tick scan
+    # (groups are independent; see run_cluster_ticks_blocked).
+    max_block = int(os.environ.get("BENCH_GROUP_BLOCK", "32768"))
+    if n_groups > max_block:
+        n_blocks = -(-n_groups // max_block)
+        block = -(-n_groups // n_blocks)  # equal blocks, minimal padding
+        run_ticks = _partial(run_cluster_ticks_blocked, group_block=block)
+    else:
+        block = 0
+        run_ticks = run_cluster_ticks
     c = DeviceCluster(cfg, seed=0)
     submit = jnp.full((n_peers, n_groups), cfg.max_submit, jnp.int32)
 
     # Warm-up: compile + elect leaders + reach steady-state replication.
     t0 = time.perf_counter()
-    states, inflight, info = run_cluster_ticks(
+    states, inflight, info = run_ticks(
         cfg, warmup_ticks, c.states, c.inflight, c.last_info, c.conn, submit)
     jax.block_until_ready(states.commit)
     warm_s = time.perf_counter() - t0
@@ -78,7 +91,7 @@ def child_run(n_groups: int, measure_ticks: int, warmup_ticks: int,
     def measure():
         nonlocal states, inflight, info
         t0 = time.perf_counter()
-        states, inflight, info = run_cluster_ticks(
+        states, inflight, info = run_ticks(
             cfg, measure_ticks, states, inflight, info, c.conn, submit)
         jax.block_until_ready(states.commit)
         return time.perf_counter() - t0
@@ -204,9 +217,10 @@ def main() -> None:
                 # Even the smoke scale can't reach the device (wedged
                 # backend).  Emit a CPU number so the artifact has data.
                 sys.stderr.write("[bench] device unreachable — CPU fallback\n")
-                fb_scale = min(g, 16_384)  # answer the requested scale where
-                                           # CPU wall time allows
-                res = run_scale(fb_scale, 64, 32, 300, platform="cpu")
+                # Answer the headline question (or the explicitly requested
+                # scale) on CPU: ~50s at 100k groups via the blocked runner.
+                fb_scale = only if only else 100_000
+                res = run_scale(fb_scale, 96, 48, 300, platform="cpu")
                 if res is not None:
                     best = res
                     emit(headline(best, fallback=True))
@@ -223,6 +237,7 @@ def main() -> None:
         emit({"metric": "AppendEntries commits/sec (no scale survived — "
                         "device and CPU fallback both failed)",
               "value": 0, "unit": "commits/sec", "vs_baseline": 0.0})
+        sys.exit(1)
 
 
 if __name__ == "__main__":
