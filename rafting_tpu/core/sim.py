@@ -94,7 +94,8 @@ def _from_blocks(tree, specs, G: int):
     return jax.tree.map(f, tree, specs)
 
 
-@partial(jax.jit, static_argnums=(0, 1, 7), static_argnames=("group_block",))
+@partial(jax.jit, static_argnums=(0, 1, 7), static_argnames=("group_block",),
+         donate_argnums=(2, 3, 4))
 def run_cluster_ticks_blocked(cfg: EngineConfig, n_ticks: int,
                               states: RaftState, inflight: Messages,
                               prev_info: StepInfo, conn: jax.Array,

@@ -35,6 +35,7 @@
 #include <map>
 #include <string>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 #include <dirent.h>
@@ -96,23 +97,47 @@ struct GroupState {
   }
 };
 
+using GroupMap = std::unordered_map<uint32_t, GroupState>;
+
+// Three-phase GC bookkeeping (begin on the tick thread, rewrite on a worker,
+// finish on the tick thread).  `frozen` and `repoint` are immutable to the
+// tick thread while `pending`; only the worker writes them.
+struct GcRepoint {
+  uint32_t g;
+  uint64_t idx;
+  int64_t term;
+  uint64_t off;   // payload offset within the compacted base segment
+  uint32_t len;
+};
+struct GcState {
+  bool pending = false;     // gc_begin done, gc_finish not yet
+  bool rewritten = false;   // gc_rewrite completed (worker -> tick handoff)
+  std::vector<uint32_t> frozen;  // sealed segment ids (ascending)
+  std::vector<GcRepoint> repoint;
+};
+
 struct Wal {
   std::string dir;
   uint64_t segment_bytes;
-  std::unordered_map<uint32_t, GroupState> groups;
+  GroupMap groups;
   // open segment
   int fd = -1;
   uint32_t seg_id = 0;
   uint64_t seg_off = 0;
   std::vector<uint8_t> buf;        // pending (unflushed) records
   std::vector<uint32_t> live_segs; // existing segment ids, ascending
+  GcState gc;
   std::string err;
 };
 
-std::string seg_path(const Wal& w, uint32_t id) {
+std::string seg_path_in(const std::string& dir, uint32_t id) {
   char name[32];
   std::snprintf(name, sizeof name, "%08u.wal", id);
-  return w.dir + "/" + name;
+  return dir + "/" + name;
+}
+
+std::string seg_path(const Wal& w, uint32_t id) {
+  return seg_path_in(w.dir, id);
 }
 
 void put_u32(std::vector<uint8_t>& b, uint32_t v) {
@@ -157,9 +182,11 @@ bool open_segment(Wal& w, uint32_t id, bool fresh) {
   return true;
 }
 
-// Apply one record body to the in-memory index.  `seg`/`payload_off` locate
-// ENTRY payload bytes for later pread.
-bool apply_body(Wal& w, const uint8_t* b, uint32_t len, uint32_t seg,
+// Apply one record body to an in-memory index.  `seg`/`payload_off` locate
+// ENTRY payload bytes for later pread.  Parametrized over the group map so
+// the GC worker can replay frozen segments into a PRIVATE map without
+// touching the live engine state.
+bool apply_body(GroupMap& groups, const uint8_t* b, uint32_t len, uint32_t seg,
                 uint64_t payload_off_base) {
   if (len < 1) return false;
   uint8_t type = b[0];
@@ -171,7 +198,7 @@ bool apply_body(Wal& w, const uint8_t* b, uint32_t len, uint32_t seg,
       int64_t term = (int64_t)get_u64(b + 13);
       uint32_t plen = get_u32(b + 21);
       if (len != 1 + 4 + 8 + 8 + 4 + plen) return false;
-      auto& gs = w.groups[g];
+      auto& gs = groups[g];
       gs.drop_suffix(idx);  // overwrite implies any old suffix at >= idx dies
       gs.entries[idx] = EntryRef{term, seg, payload_off_base + 25, plen};
       gs.tail = (int64_t)idx;
@@ -180,7 +207,7 @@ bool apply_body(Wal& w, const uint8_t* b, uint32_t len, uint32_t seg,
     case kStable: {
       if (len != 1 + 4 + 8 + 8) return false;
       uint32_t g = get_u32(b + 1);
-      auto& gs = w.groups[g];
+      auto& gs = groups[g];
       gs.stable_term = (int64_t)get_u64(b + 5);
       gs.ballot = (int64_t)get_u64(b + 13);
       gs.has_stable = true;
@@ -189,7 +216,7 @@ bool apply_body(Wal& w, const uint8_t* b, uint32_t len, uint32_t seg,
     case kTruncate: {
       if (len != 1 + 4 + 8) return false;
       uint32_t g = get_u32(b + 1);
-      w.groups[g].drop_suffix(get_u64(b + 5));
+      groups[g].drop_suffix(get_u64(b + 5));
       return true;
     }
     case kMilestone: {
@@ -197,7 +224,7 @@ bool apply_body(Wal& w, const uint8_t* b, uint32_t len, uint32_t seg,
       uint32_t g = get_u32(b + 1);
       uint64_t idx = get_u64(b + 5);
       int64_t term = (int64_t)get_u64(b + 13);
-      auto& gs = w.groups[g];
+      auto& gs = groups[g];
       if ((int64_t)idx > gs.floor) {
         gs.floor = (int64_t)idx;
         gs.floor_term = term;
@@ -209,7 +236,7 @@ bool apply_body(Wal& w, const uint8_t* b, uint32_t len, uint32_t seg,
     case kReset: {
       if (len != 1 + 4) return false;
       uint32_t g = get_u32(b + 1);
-      w.groups.erase(g);  // a later open of this lane starts from scratch
+      groups.erase(g);  // a later open of this lane starts from scratch
       return true;
     }
     default:
@@ -217,8 +244,12 @@ bool apply_body(Wal& w, const uint8_t* b, uint32_t len, uint32_t seg,
   }
 }
 
-bool replay_segment(Wal& w, uint32_t id) {
-  std::string p = seg_path(w, id);
+// Replay one segment file into `groups`.  `fix_tail` truncates the file
+// after a torn/corrupt tail (recovery behavior); the GC worker replays
+// fsynced frozen segments read-only and passes false.
+bool replay_segment_into(const std::string& dir, GroupMap& groups,
+                         uint32_t id, bool fix_tail) {
+  std::string p = seg_path_in(dir, id);
   int fd = ::open(p.c_str(), O_RDONLY);
   if (fd < 0) return false;
   struct stat st;
@@ -234,13 +265,17 @@ bool replay_segment(Wal& w, uint32_t id) {
     uint32_t crc = get_u32(&data[off + 8]);
     if (off + 12 + blen > n) break;                     // torn tail
     if (crc32(&data[off + 12], blen) != crc) break;     // corrupt tail
-    apply_body(w, &data[off + 12], blen, id, off + 12);
+    apply_body(groups, &data[off + 12], blen, id, off + 12);
     off += 12 + blen;
   }
   // If a torn tail was detected, truncate the file to the valid prefix so
   // future appends don't interleave with garbage.
-  if (off < n) ::truncate(p.c_str(), (off_t)off);
+  if (fix_tail && off < n) ::truncate(p.c_str(), (off_t)off);
   return true;
+}
+
+bool replay_segment(Wal& w, uint32_t id) {
+  return replay_segment_into(w.dir, w.groups, id, /*fix_tail=*/true);
 }
 
 bool flush_buf(Wal& w) {
@@ -272,6 +307,10 @@ void* wal_open(const char* dir, uint64_t segment_bytes) {
   w->dir = dir;
   w->segment_bytes = segment_bytes ? segment_bytes : (64u << 20);
   ::mkdir(dir, 0755);
+  // A leftover compaction temp from a crash mid-GC is garbage: the frozen
+  // segments it was built from are still live (gc_finish renames before it
+  // unlinks), so recovery replays them and the tmp is simply re-derived.
+  ::unlink((w->dir + "/gc.tmp").c_str());
   // Discover and replay segments in ascending id order.
   std::vector<uint32_t> segs;
   if (DIR* d = ::opendir(dir)) {
@@ -554,6 +593,7 @@ void wal_append_entries(void* h, uint64_t n, const uint32_t* groups,
 // retention analog, RocksLog.java:228-242).
 int wal_checkpoint(void* h) {
   Wal* w = (Wal*)h;
+  if (w->gc.pending) return -1;  // three-phase GC owns the frozen segments
   if (!flush_buf(*w)) return -1;
   ::fsync(w->fd);
   uint32_t new_id = w->seg_id + 1;
@@ -602,6 +642,201 @@ int wal_checkpoint(void* h) {
         w->live_segs.end())
       ::unlink(seg_path(*w, id).c_str());
   return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Three-phase GC: bounded tick-thread latency (VERDICT r2 #6 — the full
+// wal_checkpoint rewrite on the tick thread is a multi-second stall at scale;
+// the reference reclaims off the consensus path via RocksDB deleteRange +
+// background compaction, command/storage/RocksLog.java:228-242).
+//
+//   gc_begin   (tick thread, O(1)):  seal + rotate; freeze prior segments.
+//   gc_rewrite (worker thread):      replay the frozen files into a PRIVATE
+//                                    index, write a compacted base to gc.tmp,
+//                                    build the payload-repoint table.  Shares
+//                                    no mutable state with the live engine.
+//   gc_finish  (tick thread, O(live entries), memory-only + rename/unlink):
+//                                    verify coverage, swap the base in under
+//                                    the first frozen id, repoint EntryRefs,
+//                                    drop the rest of the frozen set.
+//
+// Correctness of the swap: the base carries the frozen prefix's compacted
+// state under id frozen[0], which sorts BEFORE every segment written after
+// gc_begin — so recovery replay order (base, then post-begin segments)
+// reproduces exactly the live state.  A crash between rename and the
+// unlinks re-replays surviving frozen segments after the base, which is a
+// no-op (each record reasserts state the base already contains or a later
+// record overrides).
+// ---------------------------------------------------------------------------
+
+int wal_gc_begin(void* h) {
+  Wal* w = (Wal*)h;
+  if (w->gc.pending) return -1;
+  if (!flush_buf(*w)) return -1;
+  if (::fsync(w->fd) != 0) return -1;
+  w->gc.frozen = w->live_segs;           // everything sealed so far
+  w->gc.repoint.clear();
+  w->gc.rewritten = false;
+  if (!open_segment(*w, w->seg_id + 1, true)) return -1;
+  w->gc.pending = true;
+  return (int)w->gc.frozen.size();
+}
+
+// Worker-thread safe: reads only dir + the frozen file set (immutable while
+// pending) and writes only gc.repoint/gc.rewritten (tick thread reads them
+// only in gc_finish, after the caller observed rewrite completion).
+int64_t wal_gc_rewrite(void* h) {
+  Wal* w = (Wal*)h;
+  if (!w->gc.pending || w->gc.rewritten) return -1;
+  GroupMap priv;
+  for (uint32_t id : w->gc.frozen)
+    if (!replay_segment_into(w->dir, priv, id, /*fix_tail=*/false)) return -1;
+
+  std::string tmp_path = w->dir + "/gc.tmp";
+  int fd = ::open(tmp_path.c_str(), O_CREAT | O_WRONLY | O_TRUNC, 0644);
+  if (fd < 0) return -1;
+  // Source-segment fd cache: live entries cluster in a handful of frozen
+  // segments; one open per segment, not per entry.
+  std::unordered_map<uint32_t, int> src_fds;
+  auto close_all = [&]() {
+    for (auto& kv : src_fds) ::close(kv.second);
+    ::close(fd);
+  };
+  std::vector<uint8_t> out;
+  out.reserve(1 << 20);
+  uint64_t written = 0;
+  auto flush_out = [&]() -> bool {
+    size_t off = 0;
+    while (off < out.size()) {
+      ssize_t wr = ::write(fd, out.data() + off, out.size() - off);
+      if (wr < 0) return false;
+      off += (size_t)wr;
+    }
+    written += out.size();
+    out.clear();
+    return true;
+  };
+  for (auto& kv : priv) {
+    uint32_t g = kv.first;
+    GroupState& gs = kv.second;
+    if (gs.has_stable) {
+      std::vector<uint8_t> body;
+      body.push_back(kStable);
+      put_u32(body, g);
+      put_u64(body, (uint64_t)gs.stable_term);
+      put_u64(body, (uint64_t)gs.ballot);
+      frame(out, body);
+    }
+    if (gs.floor > 0) {
+      std::vector<uint8_t> body;
+      body.push_back(kMilestone);
+      put_u32(body, g);
+      put_u64(body, (uint64_t)gs.floor);
+      put_u64(body, (uint64_t)gs.floor_term);
+      frame(out, body);
+    }
+    for (auto& er : gs.entries) {
+      std::vector<uint8_t> payload(er.second.len);
+      if (er.second.len) {
+        int sfd;
+        auto fit = src_fds.find(er.second.seg);
+        if (fit != src_fds.end()) {
+          sfd = fit->second;
+        } else {
+          sfd = ::open(seg_path_in(w->dir, er.second.seg).c_str(), O_RDONLY);
+          if (sfd < 0) { close_all(); return -1; }
+          src_fds[er.second.seg] = sfd;
+        }
+        ssize_t rd = ::pread(sfd, payload.data(), er.second.len,
+                             (off_t)er.second.off);
+        if (rd != (ssize_t)er.second.len) { close_all(); return -1; }
+      }
+      std::vector<uint8_t> body;
+      body.reserve(25 + er.second.len);
+      body.push_back(kEntry);
+      put_u32(body, g);
+      put_u64(body, er.first);
+      put_u64(body, (uint64_t)er.second.term);
+      put_u32(body, er.second.len);
+      body.insert(body.end(), payload.begin(), payload.end());
+      // Payload lands at: frames so far + frame header (12) + body prefix (25).
+      uint64_t payload_off = written + out.size() + 12 + 25;
+      frame(out, body);
+      w->gc.repoint.push_back(
+          GcRepoint{g, er.first, er.second.term, payload_off, er.second.len});
+      if (out.size() > (1u << 20) && !flush_out()) { close_all(); return -1; }
+    }
+  }
+  if (!flush_out()) { close_all(); return -1; }
+  if (::fsync(fd) != 0) { close_all(); return -1; }
+  close_all();
+  w->gc.rewritten = true;
+  return (int64_t)written;
+}
+
+int wal_gc_finish(void* h) {
+  Wal* w = (Wal*)h;
+  if (!w->gc.pending || !w->gc.rewritten) return -1;
+  std::unordered_set<uint32_t> frozen(w->gc.frozen.begin(),
+                                      w->gc.frozen.end());
+  uint32_t base_id = w->gc.frozen.front();
+
+  // Coverage check BEFORE any destructive step: every live payload ref into
+  // the frozen set must have a matching repoint row, else the base misses
+  // data and the swap would corrupt reads.  (Cannot happen by construction —
+  // any ref still pointing into frozen was last written there and therefore
+  // replayed — but a cheap memory-only walk buys a hard guarantee.)
+  uint64_t frozen_refs = 0, matched = 0;
+  for (auto& kv : w->groups)
+    for (auto& er : kv.second.entries)
+      if (frozen.count(er.second.seg)) frozen_refs++;
+  for (auto& rp : w->gc.repoint) {
+    auto git = w->groups.find(rp.g);
+    if (git == w->groups.end()) continue;
+    auto it = git->second.entries.find(rp.idx);
+    if (it != git->second.entries.end() && frozen.count(it->second.seg) &&
+        it->second.term == rp.term)
+      matched++;
+  }
+  if (matched != frozen_refs) { w->err = "gc coverage mismatch"; return -2; }
+
+  // Durable swap: base file takes the first frozen id (sorts before every
+  // post-begin segment), then the rest of the frozen set dies.
+  std::string tmp_path = w->dir + "/gc.tmp";
+  if (::rename(tmp_path.c_str(), seg_path(*w, base_id).c_str()) != 0) {
+    w->err = std::string("gc rename: ") + std::strerror(errno);
+    return -1;
+  }
+  if (int dfd = ::open(w->dir.c_str(), O_RDONLY); dfd >= 0) {
+    ::fsync(dfd);  // make the rename itself durable
+    ::close(dfd);
+  }
+  // Repoint live refs into the base.
+  for (auto& rp : w->gc.repoint) {
+    auto git = w->groups.find(rp.g);
+    if (git == w->groups.end()) continue;
+    auto it = git->second.entries.find(rp.idx);
+    if (it != git->second.entries.end() && frozen.count(it->second.seg) &&
+        it->second.term == rp.term)
+      it->second = EntryRef{rp.term, base_id, rp.off, rp.len};
+  }
+  for (uint32_t id : w->gc.frozen)
+    if (id != base_id) ::unlink(seg_path(*w, id).c_str());
+  std::vector<uint32_t> segs;
+  segs.push_back(base_id);
+  for (uint32_t id : w->live_segs)
+    if (!frozen.count(id)) segs.push_back(id);
+  w->live_segs = std::move(segs);
+  w->gc = GcState();
+  return 0;
+}
+
+// Abandon a pending GC (worker failed / shutdown): drop the temp, keep the
+// frozen segments live.  Always safe — nothing was swapped.
+void wal_gc_abort(void* h) {
+  Wal* w = (Wal*)h;
+  ::unlink((w->dir + "/gc.tmp").c_str());
+  w->gc = GcState();
 }
 
 const char* wal_error(void* h) { return ((Wal*)h)->err.c_str(); }

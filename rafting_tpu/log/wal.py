@@ -102,6 +102,14 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p,
             ctypes.c_void_p, ctypes.c_void_p]
+        lib.wal_gc_begin.argtypes = [ctypes.c_void_p]
+        lib.wal_gc_begin.restype = ctypes.c_int
+        lib.wal_gc_rewrite.argtypes = [ctypes.c_void_p]
+        lib.wal_gc_rewrite.restype = ctypes.c_int64
+        lib.wal_gc_finish.argtypes = [ctypes.c_void_p]
+        lib.wal_gc_finish.restype = ctypes.c_int
+        lib.wal_gc_abort.argtypes = [ctypes.c_void_p]
+        lib.wal_gc_abort.restype = None
         _lib = lib
         return lib
 
@@ -175,6 +183,18 @@ class _NativeWal:
     def checkpoint(self):
         if self._lib.wal_checkpoint(self._h) != 0:
             raise IOError("wal_checkpoint failed")
+
+    def gc_begin(self) -> int:
+        return int(self._lib.wal_gc_begin(self._h))
+
+    def gc_rewrite(self) -> int:
+        return int(self._lib.wal_gc_rewrite(self._h))
+
+    def gc_finish(self) -> int:
+        return int(self._lib.wal_gc_finish(self._h))
+
+    def gc_abort(self) -> None:
+        self._lib.wal_gc_abort(self._h)
 
     def segment_count(self):
         return int(self._lib.wal_segment_count(self._h))
@@ -259,6 +279,77 @@ class _PyGroup:
             del self.entries[i]
 
 
+def _apply_record(groups: Dict[int, "_PyGroup"], body: bytes) -> None:
+    """Apply one record body to a group map (shared by live replay and the
+    GC worker's private replay)."""
+    def G(g):
+        return groups.setdefault(g, _PyGroup())
+    t = body[0]
+    if t == _ENTRY:
+        g, idx, term, plen = struct.unpack_from("<IQQI", body, 1)
+        gs = G(g)
+        gs.drop_suffix(idx)
+        gs.entries[idx] = (_signed(term), bytes(body[25:25 + plen]))
+        gs.tail = idx
+    elif t == _STABLE:
+        g, term, ballot = struct.unpack_from("<IQQ", body, 1)
+        G(g).stable = (_signed(term), _signed(ballot))
+    elif t == _TRUNCATE:
+        g, frm = struct.unpack_from("<IQ", body, 1)
+        G(g).drop_suffix(frm)
+    elif t == _MILESTONE:
+        g, idx, term = struct.unpack_from("<IQQ", body, 1)
+        gs = G(g)
+        if idx > gs.floor:
+            gs.floor, gs.floor_term = idx, _signed(term)
+            gs.drop_prefix(idx)
+            gs.tail = max(gs.tail, gs.floor)
+    elif t == _RESET:
+        (g,) = struct.unpack_from("<I", body, 1)
+        groups.pop(g, None)
+
+
+def _replay_file(path: str, groups: Dict[int, "_PyGroup"],
+                 fix_tail: bool = True) -> None:
+    with open(path, "rb") as f:
+        data = f.read()
+    off, n = 0, len(data)
+    while off + 12 <= n:
+        magic, blen, crc = struct.unpack_from("<III", data, off)
+        if magic != _MAGIC or off + 12 + blen > n:
+            break
+        body = data[off + 12: off + 12 + blen]
+        if zlib.crc32(body) != crc:
+            break
+        _apply_record(groups, body)
+        off += 12 + blen
+    if fix_tail and off < n:
+        with open(path, "r+b") as f:
+            f.truncate(off)
+
+
+def _live_records(groups: Dict[int, "_PyGroup"]) -> bytes:
+    """Framed compacted records for a group map (the GC base segment)."""
+    out = bytearray()
+
+    def emit(body: bytes):
+        out.extend(struct.pack("<III", _MAGIC, len(body), zlib.crc32(body)))
+        out.extend(body)
+
+    for g, gs in groups.items():
+        if gs.stable is not None:
+            t, b = gs.stable
+            emit(struct.pack("<BIQQ", _STABLE, g, t & M64, b & M64))
+        if gs.floor > 0:
+            emit(struct.pack("<BIQQ", _MILESTONE, g, gs.floor,
+                             gs.floor_term & M64))
+        for idx in sorted(gs.entries):
+            term, payload = gs.entries[idx]
+            emit(struct.pack("<BIQQI", _ENTRY, g, idx, term & M64,
+                             len(payload)) + payload)
+    return bytes(out)
+
+
 class PyWal:
     """Pure-Python engine, byte-compatible with the native one."""
 
@@ -266,6 +357,10 @@ class PyWal:
         self.dir = path
         self.segment_bytes = segment_bytes
         os.makedirs(path, exist_ok=True)
+        try:
+            os.unlink(os.path.join(path, "gc.tmp"))  # crashed mid-GC: re-derivable
+        except OSError:
+            pass
         self.groups: Dict[int, _PyGroup] = {}
         segs = sorted(int(f[:8]) for f in os.listdir(path)
                       if f.endswith(".wal") and f[:8].isdigit())
@@ -275,6 +370,7 @@ class PyWal:
         self._sid = self._segs[-1]
         self._f = open(self._seg_path(self._sid), "ab")
         self._buf = bytearray()
+        self._gc = None  # {"frozen": [ids], "rewritten": bool}
 
     def _seg_path(self, sid):
         return os.path.join(self.dir, f"{sid:08d}.wal")
@@ -283,46 +379,7 @@ class PyWal:
         return self.groups.setdefault(g, _PyGroup())
 
     def _replay(self, sid):
-        with open(self._seg_path(sid), "rb") as f:
-            data = f.read()
-        off, n = 0, len(data)
-        while off + 12 <= n:
-            magic, blen, crc = struct.unpack_from("<III", data, off)
-            if magic != _MAGIC or off + 12 + blen > n:
-                break
-            body = data[off + 12: off + 12 + blen]
-            if zlib.crc32(body) != crc:
-                break
-            self._apply(body)
-            off += 12 + blen
-        if off < n:
-            with open(self._seg_path(sid), "r+b") as f:
-                f.truncate(off)
-
-    def _apply(self, body: bytes):
-        t = body[0]
-        if t == _ENTRY:
-            g, idx, term, plen = struct.unpack_from("<IQQI", body, 1)
-            gs = self._g(g)
-            gs.drop_suffix(idx)
-            gs.entries[idx] = (_signed(term), bytes(body[25:25 + plen]))
-            gs.tail = idx
-        elif t == _STABLE:
-            g, term, ballot = struct.unpack_from("<IQQ", body, 1)
-            self._g(g).stable = (_signed(term), _signed(ballot))
-        elif t == _TRUNCATE:
-            g, frm = struct.unpack_from("<IQ", body, 1)
-            self._g(g).drop_suffix(frm)
-        elif t == _MILESTONE:
-            g, idx, term = struct.unpack_from("<IQQ", body, 1)
-            gs = self._g(g)
-            if idx > gs.floor:
-                gs.floor, gs.floor_term = idx, _signed(term)
-                gs.drop_prefix(idx)
-                gs.tail = max(gs.tail, gs.floor)
-        elif t == _RESET:
-            (g,) = struct.unpack_from("<I", body, 1)
-            self.groups.pop(g, None)
+        _replay_file(self._seg_path(sid), self.groups)
 
     def _emit(self, body: bytes):
         self._buf += struct.pack("<III", _MAGIC, len(body), zlib.crc32(body))
@@ -401,7 +458,75 @@ class PyWal:
         e = gs.entries.get(idx) if gs else None
         return e[1] if e else None
 
+    # -- three-phase GC (begin/finish on the tick thread, rewrite on a
+    # worker; same contract as the native engine's wal_gc_*) ------------
+
+    def gc_begin(self) -> int:
+        if self._gc is not None:
+            return -1
+        self._flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+        frozen = list(self._segs)
+        self._sid += 1
+        self._segs.append(self._sid)
+        self._f = open(self._seg_path(self._sid), "wb")
+        self._gc = {"frozen": frozen, "rewritten": False}
+        return len(frozen)
+
+    def gc_rewrite(self) -> int:
+        """Worker-thread safe: replays the frozen FILES into a private map
+        (never touches self.groups / self._buf) and writes the compacted
+        base to gc.tmp."""
+        gc = self._gc
+        if gc is None or gc["rewritten"]:
+            return -1
+        priv: Dict[int, _PyGroup] = {}
+        for sid in gc["frozen"]:
+            _replay_file(self._seg_path(sid), priv, fix_tail=False)
+        blob = _live_records(priv)
+        tmp = os.path.join(self.dir, "gc.tmp")
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        gc["rewritten"] = True
+        return len(blob)
+
+    def gc_finish(self) -> int:
+        gc = self._gc
+        if gc is None or not gc["rewritten"]:
+            return -1
+        frozen = gc["frozen"]
+        base = frozen[0]
+        os.replace(os.path.join(self.dir, "gc.tmp"), self._seg_path(base))
+        # Make the rename durable BEFORE the unlinks: without the directory
+        # fsync, POSIX may persist the unlinks but not the rename, losing
+        # every live record that lived in frozen[1:].
+        dfd = os.open(self.dir, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+        for sid in frozen[1:]:
+            try:
+                os.unlink(self._seg_path(sid))
+            except OSError:
+                pass
+        self._segs = [base] + [s for s in self._segs if s not in frozen]
+        self._gc = None
+        return 0
+
+    def gc_abort(self) -> None:
+        try:
+            os.unlink(os.path.join(self.dir, "gc.tmp"))
+        except OSError:
+            pass
+        self._gc = None
+
     def checkpoint(self):
+        if self._gc is not None:
+            raise IOError("checkpoint refused: three-phase GC pending")
         self._flush()
         os.fsync(self._f.fileno())
         self._f.close()

@@ -158,9 +158,14 @@ class RaftNode:
         # WAL GC cadence/thresholds (VERDICT r1 #5: milestones advance the
         # logical floor, but disk is only reclaimed by the checkpoint
         # rewrite — trigger it when the dead fraction justifies the cost).
+        # The rewrite runs three-phase so the tick thread never stalls on
+        # it: begin (seal+rotate) and finish (swap+repoint) are bounded;
+        # the live-set rewrite happens on _gc_thread (VERDICT r2 #6).
         self.wal_gc_check_ticks = 128
         self.wal_gc_ratio = 4.0
         self.wal_gc_min_bytes = 8 << 20
+        self._gc_phase = 0       # 0 idle / 1 rewriting / 2 finish / -1 abort
+        self._gc_thread: Optional[threading.Thread] = None
 
         self.ticks = 0
         # Counter/gauge/histogram registry (SURVEY §5: the build must add
@@ -190,6 +195,27 @@ class RaftNode:
         # observe _stop) before the native WAL handle is released.
         for t in self._snap_threads:
             t.join(timeout=10)
+        # Settle a pending three-phase GC: with the tick thread stopped,
+        # ownership transfers here (still single-writer).
+        if self._gc_thread is not None:
+            self._gc_thread.join(timeout=300)
+            if self._gc_thread.is_alive():
+                # The worker still holds the native handle: releasing it
+                # would be a use-after-free.  Leak the store (the WAL is
+                # crash-safe; recovery re-derives everything) and bail.
+                log.error("node %d: WAL GC worker failed to stop; leaking "
+                          "store handle", self.node_id)
+                self.dispatcher.close()
+                return
+        if self._gc_phase == 2:
+            try:
+                if self.store.gc_finish() != 0:
+                    self.store.gc_abort()
+            except Exception:
+                self.store.gc_abort()
+        elif self._gc_phase != 0:
+            self.store.gc_abort()
+        self._gc_phase = 0
         self.dispatcher.close()
         self.store.close()
 
@@ -611,16 +637,54 @@ class RaftNode:
                 pass
         self._compact_grant = self.maintain.compact_targets(
             now, self.h_commit.astype(np.int64), h_base.astype(np.int64))
-        # Physical WAL GC (amortized; see LogStore.maybe_gc).
-        if self.wal_gc_check_ticks and now % self.wal_gc_check_ticks == 0:
+        self._maintain_gc(now)
+
+    def _maintain_gc(self, now: int) -> None:
+        """Physical WAL GC, three-phase so no tick stalls on the rewrite
+        (reference: RocksDB reclaims off the consensus path via deleteRange
+        + background compaction, command/storage/RocksLog.java:228-242)."""
+        if self._gc_phase == 2:       # worker done: bounded swap-in
             try:
-                if self.store.maybe_gc(self.wal_gc_ratio,
-                                       self.wal_gc_min_bytes):
+                if self.store.gc_finish() == 0:
                     self.metrics["wal_gc_runs"] += 1
-                self.metrics.gauge("wal_segments",
-                                   self.store.segment_count())
+                else:
+                    self.store.gc_abort()
             except Exception:
-                log.exception("WAL GC failed")
+                log.exception("WAL GC finish failed")
+                self.store.gc_abort()
+            self._gc_phase = 0
+            self._gc_thread = None
+            self.metrics.gauge("wal_segments", self.store.segment_count())
+        elif self._gc_phase == -1:    # worker failed: drop the attempt
+            self.store.gc_abort()
+            self._gc_phase = 0
+            self._gc_thread = None
+        elif (self._gc_phase == 0 and self.wal_gc_check_ticks
+              and now % self.wal_gc_check_ticks == 0):
+            try:
+                if not self.store.should_gc(self.wal_gc_ratio,
+                                            self.wal_gc_min_bytes):
+                    return
+                if self.store.gc_begin() < 0:
+                    return
+            except Exception:
+                log.exception("WAL GC begin failed")
+                return
+            self._gc_phase = 1
+            self._gc_thread = threading.Thread(
+                target=self._gc_worker,
+                name=f"raft-walgc-{self.node_id}", daemon=True)
+            self._gc_thread.start()
+
+    def _gc_worker(self) -> None:
+        try:
+            ok = self.store.gc_rewrite() >= 0
+        except Exception:
+            log.exception("WAL GC rewrite failed")
+            ok = False
+        # Handoff: the tick thread performs finish/abort (single-writer
+        # rule — the worker never touches live engine state).
+        self._gc_phase = 2 if ok else -1
 
     # -------------------------------------------------------------- snapshot
 

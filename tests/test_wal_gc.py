@@ -1,0 +1,164 @@
+"""Three-phase WAL GC: bounded tick-thread latency with the live-set rewrite
+on a worker (VERDICT r2 #6 — the synchronous checkpoint was a multi-second
+tick stall at scale; the reference reclaims off the consensus path,
+command/storage/RocksLog.java:228-242).
+
+Covers: both engines' begin/rewrite/finish with writes interleaved during the
+pending window, payload repointing after the swap, recovery from the swapped
+files, the crash window between rename and unlink (surviving frozen segments
+replay as a no-op over the base), and — on the full node runtime — that GC
+cycles under load never stall a tick past the election timeout.
+"""
+
+import os
+import shutil
+import time
+
+import numpy as np
+import pytest
+
+from rafting_tpu.core.types import EngineConfig, LEADER
+from rafting_tpu.log.wal import WalStore, native_available
+from rafting_tpu.testkit.harness import LocalCluster
+
+ENGINES = [pytest.param(True, id="python"),
+           pytest.param(False, id="native",
+                        marks=pytest.mark.skipif(not native_available(),
+                                                 reason="no toolchain"))]
+
+
+def _load(w, n_groups=3, n=200):
+    for g in range(n_groups):
+        w.append_stable(g, 5, 1)
+        for i in range(1, n + 1):
+            w.append_entry(g, i, 5, b"x" * 50)
+    w.sync()
+    for g in range(n_groups):
+        w.milestone(g, n - 50, 5)  # drop prefixes -> mostly-dead segments
+    w.sync()
+
+
+@pytest.mark.parametrize("force_py", ENGINES)
+def test_three_phase_gc_with_interleaved_writes(tmp_path, force_py):
+    w = WalStore(str(tmp_path / "wal"), segment_bytes=1 << 14,
+                 force_python=force_py)
+    _load(w)
+    assert w.gc_begin() >= 1
+    assert w.gc_begin() == -1, "second begin refused while pending"
+    # Writes during the pending window land in post-begin segments and must
+    # survive the swap untouched.
+    for g in range(3):
+        for i in range(201, 221):
+            w.append_entry(g, i, 6, b"y" * 50)
+    w.sync()
+    assert w.gc_rewrite() >= 0
+    w.truncate(0, 210)      # structural op after the rewrite, before finish
+    w.sync()
+    assert w.gc_finish() == 0
+
+    # Reads go through repointed refs (native) / in-memory payloads (py).
+    assert w.entry_payload(1, 160) == b"x" * 50
+    assert w.entry_payload(1, 205) == b"y" * 50
+    assert w.tail(0) == 209   # truncate(0, 210) drops indices >= 210
+    assert w.floor(2) == 150
+    assert w.segment_count() <= 2
+    w.close()
+
+    # Recovery replays base + post-begin segments.
+    w2 = WalStore(str(tmp_path / "wal"), segment_bytes=1 << 14,
+                  force_python=force_py)
+    assert w2.entry_payload(1, 160) == b"x" * 50
+    assert w2.entry_payload(0, 205) == b"y" * 50
+    assert w2.tail(0) == 209
+    assert w2.stable(1) == (5, 1)
+    w2.close()
+
+
+@pytest.mark.parametrize("force_py", ENGINES)
+def test_gc_crash_between_rename_and_unlink(tmp_path, force_py):
+    """If the process dies after the base swap but before the frozen
+    segments are unlinked, recovery replays base then the surviving frozen
+    files — which must be a state no-op (every record reasserts what the
+    base already holds or a later segment overrides)."""
+    d = str(tmp_path / "wal")
+    w = WalStore(d, segment_bytes=1 << 14, force_python=force_py)
+    _load(w)
+    frozen_files = sorted(f for f in os.listdir(d) if f.endswith(".wal"))
+    saved = {f: open(os.path.join(d, f), "rb").read() for f in frozen_files}
+    assert w.gc_begin() >= 1
+    assert w.gc_rewrite() >= 0
+    assert w.gc_finish() == 0
+    w.close()
+
+    # Resurrect the frozen set EXCEPT the base id (gc_finish renamed over
+    # it) — the crash-window disk state.
+    base = sorted(saved)[0]
+    for f, blob in saved.items():
+        if f != base and not os.path.exists(os.path.join(d, f)):
+            with open(os.path.join(d, f), "wb") as fh:
+                fh.write(blob)
+
+    w2 = WalStore(d, segment_bytes=1 << 14, force_python=force_py)
+    for g in range(3):
+        assert w2.floor(g) == 150
+        assert w2.tail(g) == 200
+        assert w2.stable(g) == (5, 1)
+        assert w2.entry_payload(g, 180) == b"x" * 50
+    w2.close()
+
+
+def test_gc_abort_keeps_state(tmp_path):
+    w = WalStore(str(tmp_path / "wal"), segment_bytes=1 << 14)
+    _load(w)
+    assert w.gc_begin() >= 1
+    w.gc_abort()
+    assert w.entry_payload(0, 160) == b"x" * 50
+    # A fresh cycle works after an abort.
+    assert w.gc_begin() >= 1
+    assert w.gc_rewrite() >= 0
+    assert w.gc_finish() == 0
+    assert w.entry_payload(0, 160) == b"x" * 50
+    w.close()
+
+
+def test_gc_never_stalls_ticks_past_election_timeout(tmp_path):
+    """Chaos criterion from VERDICT r2 #6: at >= 1k groups with GC forced to
+    cycle continuously under load, no tick may stall longer than the
+    election timeout (10 ticks x the 20ms default interval = 200ms)."""
+    G = 1024
+    cfg = EngineConfig(n_groups=G, n_peers=3, log_slots=32, batch=8,
+                       max_submit=8, election_ticks=10, heartbeat_ticks=3,
+                       rpc_timeout_ticks=8)
+    c = LocalCluster(cfg, str(tmp_path), seed=11)
+    try:
+        for node in c.nodes.values():
+            node.wal_gc_check_ticks = 4   # re-check near-constantly
+            node.wal_gc_ratio = 0.0       # any footprint triggers
+            node.wal_gc_min_bytes = 1
+        c.wait_leader(0, max_rounds=300)
+        # Per-NODE tick latency: wrap every node's tick so a single node's
+        # stall cannot hide behind the other nodes' fast ticks.
+        latencies = []
+        for node in c.nodes.values():
+            orig = node.tick
+
+            def timed(orig=orig):
+                t0 = time.perf_counter()
+                r = orig()
+                latencies.append(time.perf_counter() - t0)
+                return r
+            node.tick = timed
+        loaded = list(range(0, G, 8))     # 128 lanes under real payload load
+        for round_no in range(30):
+            for g in loaded[:32]:
+                lead = c.leader_of(g)
+                if lead is not None and c.nodes[lead].is_ready(g):
+                    c.nodes[lead].submit(g, b"p" * 256)
+            c.tick(1)
+        gc_runs = sum(n.metrics["wal_gc_runs"] for n in c.nodes.values())
+        assert gc_runs >= 2, f"GC barely ran ({gc_runs}) — test is vacuous"
+        worst = max(latencies)
+        assert worst < 0.200, (
+            f"a tick stalled {worst * 1000:.0f}ms >= election timeout")
+    finally:
+        c.close()
