@@ -225,7 +225,11 @@ bool apply_body(GroupMap& groups, const uint8_t* b, uint32_t len, uint32_t seg,
       uint64_t idx = get_u64(b + 5);
       int64_t term = (int64_t)get_u64(b + 13);
       auto& gs = groups[g];
-      if ((int64_t)idx > gs.floor) {
+      // `>=` (not `>`): re-applying the current milestone must be a state
+      // no-op INCLUDING its drop_prefix/tail-raise effects — the GC crash
+      // window replays stale frozen segments AFTER the compacted base, and
+      // a strict guard would let resurrected sub-floor entries survive.
+      if ((int64_t)idx >= gs.floor) {
         gs.floor = (int64_t)idx;
         gs.floor_term = term;
         gs.drop_prefix(idx);
@@ -396,7 +400,7 @@ void wal_milestone(void* h, uint32_t group, uint64_t index, int64_t term) {
   put_u64(body, index);
   put_u64(body, (uint64_t)term);
   auto& gs = w->groups[group];
-  if ((int64_t)index > gs.floor) {
+  if ((int64_t)index >= gs.floor) {  // mirror apply_body's replay semantics
     gs.floor = (int64_t)index;
     gs.floor_term = term;
     gs.drop_prefix(index);

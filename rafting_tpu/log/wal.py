@@ -300,7 +300,10 @@ def _apply_record(groups: Dict[int, "_PyGroup"], body: bytes) -> None:
     elif t == _MILESTONE:
         g, idx, term = struct.unpack_from("<IQQ", body, 1)
         gs = G(g)
-        if idx > gs.floor:
+        # `>=` (not `>`): re-applying the current milestone must be a full
+        # state no-op incl. drop_prefix/tail-raise — the GC crash window
+        # replays stale frozen segments AFTER the compacted base.
+        if idx >= gs.floor:
             gs.floor, gs.floor_term = idx, _signed(term)
             gs.drop_prefix(idx)
             gs.tail = max(gs.tail, gs.floor)
@@ -416,7 +419,7 @@ class PyWal:
 
     def milestone(self, g, idx, term):
         gs = self._g(g)
-        if idx > gs.floor:
+        if idx >= gs.floor:  # mirror _apply_record's replay semantics
             gs.floor, gs.floor_term = idx, term
             gs.drop_prefix(idx)
             gs.tail = max(gs.tail, gs.floor)
@@ -535,16 +538,8 @@ class PyWal:
         new_id = self._sid
         self._segs = [new_id]
         self._f = open(self._seg_path(new_id), "wb")
-        for g, gs in self.groups.items():
-            if gs.stable is not None:
-                self.append_stable(g, *gs.stable)
-            if gs.floor > 0:
-                self._emit(struct.pack("<BIQQ", _MILESTONE, g, gs.floor,
-                                       gs.floor_term & M64))
-            for idx in sorted(gs.entries):
-                term, payload = gs.entries[idx]
-                self._emit(struct.pack("<BIQQI", _ENTRY, g, idx, term & M64,
-                                       len(payload)) + payload)
+        # Same serialization as the GC base (one definition, no drift).
+        self._buf += _live_records(self.groups)
         self.sync()
         for sid in old:
             if sid not in self._segs:

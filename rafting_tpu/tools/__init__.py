@@ -1,0 +1,1 @@
+"""Operational CLI tools: standalone node runner, offline log checker."""

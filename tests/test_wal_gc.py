@@ -107,6 +107,40 @@ def test_gc_crash_between_rename_and_unlink(tmp_path, force_py):
     w2.close()
 
 
+@pytest.mark.parametrize("force_py", ENGINES)
+def test_gc_crash_window_after_snapshot_discarded_log(tmp_path, force_py):
+    """Milestone re-application must be idempotent at idx == floor: a
+    snapshot install past the log tail (floor rises ABOVE every entry)
+    followed by the GC crash window replays frozen ENTRY records below the
+    floor, and the trailing MILESTONE must re-drop them and re-raise the
+    tail (review finding r3: the strict `idx > floor` guard resurrected
+    ghost sub-floor entries and regressed tail below floor)."""
+    d = str(tmp_path / "wal")
+    w = WalStore(d, segment_bytes=1 << 14, force_python=force_py)
+    for i in range(1, 11):
+        w.append_entry(7, i, 3, b"e" * 30)
+    w.sync()
+    w.milestone(7, 12, 4)   # snapshot at idx 12 > tail: log fully discarded
+    w.sync()
+    frozen_files = sorted(f for f in os.listdir(d) if f.endswith(".wal"))
+    saved = {f: open(os.path.join(d, f), "rb").read() for f in frozen_files}
+    assert w.gc_begin() >= 1
+    assert w.gc_rewrite() >= 0
+    assert w.gc_finish() == 0
+    w.close()
+    base = sorted(saved)[0]
+    for f, blob in saved.items():  # crash window: unlinks never persisted
+        if f != base and not os.path.exists(os.path.join(d, f)):
+            with open(os.path.join(d, f), "wb") as fh:
+                fh.write(blob)
+    w2 = WalStore(d, segment_bytes=1 << 14, force_python=force_py)
+    assert w2.floor(7) == 12
+    assert w2.tail(7) == 12, "tail must not regress below the floor"
+    assert w2.entry_term(7, 5) == -1, "sub-floor entries must stay dead"
+    assert w2.entry_payload(7, 5) is None
+    w2.close()
+
+
 def test_gc_abort_keeps_state(tmp_path):
     w = WalStore(str(tmp_path / "wal"), segment_bytes=1 << 14)
     _load(w)
