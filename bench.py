@@ -96,10 +96,8 @@ def child_run(n_groups: int, measure_ticks: int, warmup_ticks: int,
         jax.block_until_ready(states.commit)
         return time.perf_counter() - t0
 
-    if profile_dir:
-        with jax.profiler.trace(profile_dir):
-            elapsed = measure()
-    else:
+    from rafting_tpu.utils.profiling import device_trace
+    with device_trace(profile_dir):   # no-op when unset
         elapsed = measure()
 
     end_commit = int(np.asarray(states.commit).max(axis=0).astype(np.int64).sum())
@@ -220,7 +218,9 @@ def main() -> None:
                 # Answer the headline question (or the explicitly requested
                 # scale) on CPU: ~50s at 100k groups via the blocked runner.
                 fb_scale = only if only else 100_000
-                res = run_scale(fb_scale, 96, 48, 300, platform="cpu")
+                fb_timeout = max(
+                    60, min(300, budget - (time.monotonic() - t_start)))
+                res = run_scale(fb_scale, 96, 48, fb_timeout, platform="cpu")
                 if res is not None:
                     best = res
                     emit(headline(best, fallback=True))

@@ -6,7 +6,12 @@ the product path users actually run: real RaftNodes with WAL durability
 (persist-before-send barrier), state-machine applies, snapshots/compaction
 maintenance and the loopback transport, across a 3-node in-process cluster.
 
-Prints ONE JSON line like bench.py.  Usage: bench_runtime.py [n_groups]
+Prints one JSON line per scale; the host runtime is the subject, so the
+engine is pinned to CPU by default (pass --default-backend to benchmark the
+runtime over a real accelerator engine — and note a wedged TPU plugin hangs
+at backend init, the exact failure bench.py's ladder defends against).
+
+Usage: bench_runtime.py [n_groups ...] [--default-backend]
 """
 
 import json
@@ -77,10 +82,13 @@ def run(n_groups: int = 1024, rounds: int = 60) -> dict:
         assert (leaders >= 0).all()
 
         def offer():
+            # Dense load at the design point: fill every group's per-tick
+            # acceptance budget (max_submit), not one token command.
             for g in range(n_groups):
                 n = c.nodes[int(leaders[g])]
                 if n.h_role[g] == LEADER and n.h_ready[g]:
-                    n.submit(g, payload)
+                    for _ in range(cfg.max_submit):
+                        n.submit(g, payload)
 
         # Warmup.
         for _ in range(5):
@@ -109,5 +117,12 @@ def run(n_groups: int = 1024, rounds: int = 60) -> dict:
 
 
 if __name__ == "__main__":
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
-    print(json.dumps(run(n_groups=n)))
+    args = sys.argv[1:]
+    if "--default-backend" in args:
+        args.remove("--default-backend")
+    else:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    scales = [int(a) for a in args] or [1024]
+    for n in scales:
+        print(json.dumps(run(n_groups=n)), flush=True)

@@ -35,8 +35,12 @@ class LogStore:
     def __init__(self, path: str, segment_bytes: int = 64 << 20, *,
                  force_python: bool = False):
         self.wal = WalStore(path, segment_bytes, force_python=force_python)
-        # (group, index) -> payload bytes; hot mirror of the live window.
-        self._cache: Dict[tuple, bytes] = {}
+        # group -> {index -> payload bytes}; hot mirror of the live window.
+        # Keyed per group so floor/truncate/reset maintenance scans only
+        # that group's window, never the whole node's cache (a flat dict
+        # made set_floor O(total cache) per group — O(G^2) per tick under
+        # dense load).
+        self._cache: Dict[int, Dict[int, bytes]] = {}
         # last durable (term, ballot) per group, to skip no-op stable writes
         self._stable: Dict[int, tuple] = {}
         self._durable_tail: Dict[int, int] = {}
@@ -46,10 +50,11 @@ class LogStore:
     def append_entries(self, g: int, start: int, terms: Sequence[int],
                        payloads: Sequence[bytes]) -> None:
         """Write entries [start, start+len) (overwrite semantics)."""
+        gc = self._cache.setdefault(g, {})
         for k, (t, p) in enumerate(zip(terms, payloads)):
             idx = start + k
             self.wal.append_entry(g, idx, int(t), p)
-            self._cache[(g, idx)] = p
+            gc[idx] = p
         self._durable_tail[g] = max(self._durable_tail.get(g, 0),
                                     start + len(terms) - 1)
 
@@ -62,7 +67,7 @@ class LogStore:
         self.wal.append_batch(groups, idxs, terms, payloads)
         for g, i, p in zip(groups, idxs, payloads):
             g, i = int(g), int(i)
-            self._cache[(g, i)] = p
+            self._cache.setdefault(g, {})[i] = p
             if i > self._durable_tail.get(g, 0):
                 self._durable_tail[g] = i
 
@@ -72,9 +77,10 @@ class LogStore:
         if self._durable_tail.get(g, self.wal.tail(g)) > tail:
             self.wal.truncate(g, tail + 1)
             self._durable_tail[g] = tail
-            for key in [k for k in self._cache
-                        if k[0] == g and k[1] > tail]:
-                del self._cache[key]
+            gc = self._cache.get(g)
+            if gc:
+                for k in [k for k in gc if k > tail]:
+                    del gc[k]
 
     def put_stable(self, g: int, term: int, ballot: int) -> None:
         if self._stable.get(g) == (term, ballot):
@@ -87,8 +93,10 @@ class LogStore:
         if index <= self.wal.floor(g):
             return
         self.wal.milestone(g, index, term)
-        for key in [k for k in self._cache if k[0] == g and k[1] <= index]:
-            del self._cache[key]
+        gc = self._cache.get(g)
+        if gc:
+            for k in [k for k in gc if k <= index]:
+                del gc[k]
         self._durable_tail[g] = max(self._durable_tail.get(g, 0), index)
 
     def reset_group(self, g: int) -> None:
@@ -97,8 +105,7 @@ class LogStore:
         scratch (the reference deletes the group's RocksDB dir,
         command/storage/RocksStateLoader.java:48-59)."""
         self.wal.reset(g)
-        for key in [k for k in self._cache if k[0] == g]:
-            del self._cache[key]
+        self._cache.pop(g, None)
         self._stable.pop(g, None)
         self._durable_tail.pop(g, None)
 
@@ -151,12 +158,13 @@ class LogStore:
     # -- reads ---------------------------------------------------------------
 
     def payload(self, g: int, idx: int) -> Optional[bytes]:
-        p = self._cache.get((g, idx))
+        gc = self._cache.setdefault(g, {})
+        p = gc.get(idx)
         if p is not None:
             return p
         p = self.wal.entry_payload(g, idx)
         if p is not None:
-            self._cache[(g, idx)] = p
+            gc[idx] = p
         return p
 
     def payload_batch(self, g: int, start: int, n: int) -> List[bytes]:

@@ -26,6 +26,11 @@ class FileMachine:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._f = open(path, "a+")
         self._last_applied = self._count_lines()
+        # One startup sweep for crash leftovers; afterwards checkpoints are
+        # tracked in memory (a glob per checkpoint scans the whole shared
+        # machines dir — O(groups) per call, O(groups^2) per tick at scale).
+        self._prune_ckpts()
+        self._last_ckpt: Optional[str] = None
 
     def _count_lines(self) -> int:
         """last_applied = index of the final line (reference counts lines,
@@ -57,9 +62,14 @@ class FileMachine:
     def checkpoint(self, must_include: int) -> Checkpoint:
         assert self._last_applied >= must_include
         os.fsync(self._f.fileno())
-        self._prune_ckpts()
+        if self._last_ckpt:
+            try:
+                os.unlink(self._last_ckpt)
+            except OSError:
+                pass
         tmp = f"{self.path}.ckpt.{self._last_applied}"
         shutil.copyfile(self.path, tmp)
+        self._last_ckpt = tmp
         return Checkpoint(path=tmp, index=self._last_applied)
 
     def _prune_ckpts(self) -> None:

@@ -57,6 +57,7 @@ from ..api.anomaly import (
     BusyLoopError, NotLeaderError, NotReadyError, ObsoleteContextError,
 )
 from ..utils.metrics import Metrics
+from ..utils.profiling import TickProfiler
 
 log = logging.getLogger(__name__)
 
@@ -171,6 +172,9 @@ class RaftNode:
         # Counter/gauge/histogram registry (SURVEY §5: the build must add
         # commits/sec, election counts, per-step latency histograms).
         self.metrics = Metrics()
+        # Device-profiler hook (SURVEY §5): bounded capture of the tick
+        # loop; armed via profile_ticks() or RAFT_PROFILE_DIR.
+        self.profiler = TickProfiler.from_env()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -216,6 +220,7 @@ class RaftNode:
         elif self._gc_phase != 0:
             self.store.gc_abort()
         self._gc_phase = 0
+        self.profiler.close()
         self.dispatcher.close()
         self.store.close()
 
@@ -228,7 +233,18 @@ class RaftNode:
         NotReady (leading but a majority of followers unhealthy —
         Leader.isReady, Leader.java:52-64 -> NotReadyException,
         RaftStub.java:84-87) and BusyLoop (bounded queues,
-        support/EventLoop.java:136-138)."""
+        support/EventLoop.java:136-138).
+
+        Concurrency contract: ``h_role``/``h_ready``/``h_leader`` are
+        device mirrors refreshed once per tick and read here WITHOUT
+        synchronization (the reference instead pins the isReady check to
+        the group's event loop, Leader.java:52-64).  The race is bounded
+        and safe: a stale mirror can only mis-route a submission by one
+        tick — a wrongly-ACCEPTED command still commits only if the device
+        engine (the authority) sees this node as a ready leader when it
+        drains the queue, otherwise the queue is rejected with NotLeader on
+        the next tick (`_persist` rejection sweep); a wrongly-REFUSED
+        command just returns a retryable error to the client."""
         fut: Future = Future()
         if not self.h_active[group]:
             fut.set_exception(ObsoleteContextError(f"group {group} closed"))
@@ -312,7 +328,17 @@ class RaftNode:
             os.replace(tmp, self._lane_gens_path)
         self.set_active(lane, True)
 
+    def profile_ticks(self, log_dir: str, n_ticks: int = 64) -> None:
+        """Capture the next ``n_ticks`` ticks to a JAX profiler trace."""
+        self.profiler.arm(log_dir, n_ticks)
+
     def tick(self) -> StepInfo:
+        with self.profiler.step(self.ticks):
+            info = self._tick_inner()
+        self.profiler.after_tick()
+        return info
+
+    def _tick_inner(self) -> StepInfo:
         _tick_t0 = time.perf_counter()
         cfg = self.cfg
         G, P = cfg.n_groups, cfg.n_peers

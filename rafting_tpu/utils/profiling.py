@@ -1,0 +1,104 @@
+"""Device profiling hooks (SURVEY §5: the reference has no tracing at all —
+logback lines only, support/RaftConfig.java:137-141 — so the TPU build adds
+JAX profiler integration from the start).
+
+Two entry points:
+
+* :func:`device_trace` — context manager wrapping a measurement region in
+  ``jax.profiler.trace`` so XLA device timelines land in TensorBoard format
+  (the benchmark uses this around its measure loop via BENCH_PROFILE_DIR).
+* :meth:`TickProfiler` — bounded capture of a live node's tick loop: each
+  tick becomes a ``StepTraceAnnotation`` so host phases and the fused device
+  step line up on one timeline.  Armed via RaftNode.profile_ticks() or the
+  RAFT_PROFILE_DIR environment variable.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Optional
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: Optional[str]):
+    """Trace the enclosed region to ``log_dir`` (no-op if falsy)."""
+    if not log_dir:
+        yield
+        return
+    import jax
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+# jax.profiler traces are PROCESS-global (start_trace raises if one is
+# already running), so at most one TickProfiler may hold a trace at a time —
+# in-process multi-node harnesses construct several RaftNodes, and with
+# RAFT_PROFILE_DIR set each would otherwise try to arm.
+_TRACE_OWNER: list = []
+
+
+class TickProfiler:
+    """Capture N ticks of a node runtime into a profiler trace.
+
+    Start/stop are explicit and bounded (a trace left running grows without
+    bound); each tick is annotated so per-phase host time and device time
+    correlate in the viewer.  Only the first profiler to arm in a process
+    captures — later arms are silently skipped (the trace is process-global).
+    """
+
+    def __init__(self):
+        self._remaining = 0
+        self._active = False
+
+    def arm(self, log_dir: str, n_ticks: int = 64) -> None:
+        if self._active or not log_dir or n_ticks <= 0 or _TRACE_OWNER:
+            return
+        import jax
+        os.makedirs(log_dir, exist_ok=True)
+        try:
+            jax.profiler.start_trace(log_dir)
+        except RuntimeError:  # someone else (outside this module) is tracing
+            return
+        _TRACE_OWNER.append(self)
+        self._remaining = n_ticks
+        self._active = True
+
+    @classmethod
+    def from_env(cls) -> "TickProfiler":
+        """Armed from RAFT_PROFILE_DIR / RAFT_PROFILE_TICKS if set."""
+        p = cls()
+        d = os.environ.get("RAFT_PROFILE_DIR", "")
+        if d:
+            p.arm(d, int(os.environ.get("RAFT_PROFILE_TICKS", "64")))
+        return p
+
+    def step(self, tick_no: int):
+        """Context for one tick; stops the trace after the armed budget."""
+        if not self._active:
+            return contextlib.nullcontext()
+        import jax
+        return jax.profiler.StepTraceAnnotation("raft_tick", step_num=tick_no)
+
+    def after_tick(self) -> None:
+        if not self._active:
+            return
+        self._remaining -= 1
+        if self._remaining <= 0:
+            import jax
+            jax.profiler.stop_trace()
+            self._release()
+
+    def _release(self) -> None:
+        self._active = False
+        if self in _TRACE_OWNER:
+            _TRACE_OWNER.remove(self)
+
+    def close(self) -> None:
+        if self._active:
+            import jax
+            try:
+                jax.profiler.stop_trace()
+            except RuntimeError:
+                pass
+            self._release()
