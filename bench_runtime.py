@@ -81,14 +81,16 @@ def run(n_groups: int = 1024, rounds: int = 60) -> dict:
                             else -1 for g in range(n_groups)])
         assert (leaders >= 0).all()
 
+        burst = [payload] * cfg.max_submit
+
         def offer():
             # Dense load at the design point: fill every group's per-tick
-            # acceptance budget (max_submit), not one token command.
+            # acceptance budget (max_submit) through the batch API (one
+            # future + one lock acquisition per group per round).
             for g in range(n_groups):
                 n = c.nodes[int(leaders[g])]
                 if n.h_role[g] == LEADER and n.h_ready[g]:
-                    for _ in range(cfg.max_submit):
-                        n.submit(g, payload)
+                    n.submit_batch(g, burst)
 
         # Warmup.
         for _ in range(5):

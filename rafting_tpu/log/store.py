@@ -168,10 +168,23 @@ class LogStore:
         return p
 
     def payload_batch(self, g: int, start: int, n: int) -> List[bytes]:
-        out = []
-        for i in range(start, start + n):
-            p = self.payload(g, i)
-            out.append(b"" if p is None else p)
+        return [b"" if p is None else p
+                for p in self.payloads_window(g, start, n)]
+
+    def payloads_window(self, g: int, start: int, n: int
+                        ) -> List[Optional[bytes]]:
+        """Payloads for [start, start+n) with None where absent — one
+        cache-dict resolution for the whole window (the replication pack
+        path calls this once per AE column instead of once per entry)."""
+        gc = self._cache.setdefault(g, {})
+        out: List[Optional[bytes]] = []
+        for idx in range(start, start + n):
+            p = gc.get(idx)
+            if p is None:
+                p = self.wal.entry_payload(g, idx)
+                if p is not None:
+                    gc[idx] = p
+            out.append(p)
         return out
 
     def entry_term(self, g: int, idx: int) -> int:

@@ -29,13 +29,18 @@ log = logging.getLogger(__name__)
 
 class ApplyDispatcher:
     def __init__(self, provider: MachineProvider, payload_fn,
-                 on_applied: Optional[Callable[[int, int], None]] = None):
+                 on_applied: Optional[Callable[[int, int], None]] = None,
+                 payload_window_fn=None):
         """payload_fn(group, index) -> bytes | None (usually LogStore.payload).
+        payload_window_fn(group, start, n) -> [bytes|None]: batched variant
+        (LogStore.payloads_window) — the apply loop fetches each group's
+        newly committed window in one call when provided.
 
         on_applied(group, new_last_applied): progress hook (maintain policy).
         """
         self._provider = provider
         self._payload = payload_fn
+        self._payload_window = payload_window_fn
         self._machines: Dict[int, RaftMachine] = {}
         self._halted: Dict[int, bool] = {}
         self._promises: Dict[tuple, Future] = {}
@@ -150,8 +155,17 @@ class ApplyDispatcher:
             idx = before + 1
             hi = target if max_per_group <= 0 \
                 else min(target, idx + max_per_group - 1)
+            # Probe the first index before prefetching the window: a group
+            # whose frontier is far ahead of its local store (snapshot
+            # pending) must cost one lookup per tick, not one per missing
+            # entry.  The probe's hit is cached, so no duplicate work.
+            window = None
+            if (self._payload_window is not None and hi >= idx
+                    and self._payload(g, idx) is not None):
+                window = self._payload_window(g, idx, hi - idx + 1)
             while idx <= hi:
-                payload = self._payload(g, idx)
+                payload = (window[idx - before - 1] if window is not None
+                           else self._payload(g, idx))
                 if payload is None:
                     # Frontier ahead of locally stored entries (e.g. device
                     # committed via snapshot milestone); the machine must

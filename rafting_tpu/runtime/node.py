@@ -62,6 +62,52 @@ from ..utils.profiling import TickProfiler
 log = logging.getLogger(__name__)
 
 
+class BatchSubmit:
+    """One future for a whole batch of commands (resolves to the list of
+    apply results in submission order).  Amortizes the per-command
+    ``Future`` cost — a ``threading.Condition`` allocation per command was
+    the top client-side cost under dense load.  Completion/failure happen
+    on the tick thread only (the dispatcher's single-writer rule), so no
+    extra locking is needed."""
+
+    __slots__ = ("future", "results", "_remaining")
+
+    def __init__(self, n: int):
+        self.future: Future = Future()
+        self.results: list = [None] * n
+        self._remaining = n
+
+    def _complete(self, k: int, result) -> None:
+        self.results[k] = result
+        self._remaining -= 1
+        if self._remaining == 0 and not self.future.done():
+            self.future.set_result(self.results)
+
+    def _fail(self, err: Exception) -> None:
+        if not self.future.done():
+            self.future.set_exception(err)
+
+
+class _BatchSlot:
+    """Future-compatible handle for one command inside a BatchSubmit (the
+    promise map and rejection sweeps treat it exactly like a Future)."""
+
+    __slots__ = ("batch", "k")
+
+    def __init__(self, batch: BatchSubmit, k: int):
+        self.batch = batch
+        self.k = k
+
+    def done(self) -> bool:
+        return self.batch.future.done()
+
+    def set_result(self, result) -> None:
+        self.batch._complete(self.k, result)
+
+    def set_exception(self, err: Exception) -> None:
+        self.batch._fail(err)
+
+
 class RaftNode:
     def __init__(self, cfg: EngineConfig, node_id: int, data_dir: str,
                  provider: MachineProvider,
@@ -85,7 +131,9 @@ class RaftNode:
 
         self.store = LogStore(os.path.join(data_dir, "wal"))
         self.archive = SnapshotArchive(os.path.join(data_dir, "snapshots"))
-        self.dispatcher = ApplyDispatcher(provider, self._payload)
+        self.dispatcher = ApplyDispatcher(
+            provider, self._payload,
+            payload_window_fn=self.store.payloads_window)
         self.maintain = maintain or MaintainAgreement(cfg.n_groups)
         self.template = messages_template(cfg)
         self.acc = InboxAccumulator(cfg, self.template)
@@ -246,17 +294,9 @@ class RaftNode:
         the next tick (`_persist` rejection sweep); a wrongly-REFUSED
         command just returns a retryable error to the client."""
         fut: Future = Future()
-        if not self.h_active[group]:
-            fut.set_exception(ObsoleteContextError(f"group {group} closed"))
-            return fut
-        if self.h_role[group] != LEADER:
-            hint = int(self.h_leader[group])
-            fut.set_exception(NotLeaderError(
-                group, None if hint == NIL else hint))
-            return fut
-        if not self.h_ready[group]:
-            fut.set_exception(NotReadyError(
-                f"group {group}: leader lacks a healthy majority"))
+        err = self._refusal(group)
+        if err is not None:
+            fut.set_exception(err)
             return fut
         with self._submit_lock:
             q = self._submissions.setdefault(group, [])
@@ -269,6 +309,49 @@ class RaftNode:
             q.append((payload, fut))
             self._queued_total += 1
         return fut
+
+    def submit_batch(self, group: int, payloads) -> Future:
+        """Offer many commands with ONE future resolving to the list of
+        apply results (in order).  Same refusal taxonomy as :meth:`submit`,
+        reported on the single future; one queue-capacity check and one
+        lock acquisition cover the whole batch.  If any command in the
+        batch fails (NotLeader on step-down, ObsoleteContext, snapshot
+        jump), the whole batch's future fails — clients treat it like a
+        per-command error and re-check/resubmit."""
+        batch = BatchSubmit(len(payloads))
+        fut = batch.future
+        if not payloads:
+            fut.set_result([])
+            return fut
+        err = self._refusal(group)
+        if err is not None:
+            fut.set_exception(err)
+            return fut
+        with self._submit_lock:
+            q = self._submissions.setdefault(group, [])
+            if (len(q) + len(payloads) > self.group_queue_cap
+                    or self._queued_total + len(payloads)
+                    > self.total_queue_cap - self.busy_threshold):
+                fut.set_exception(BusyLoopError(
+                    f"group {group}: submission queue full"))
+                return fut
+            q.extend((p, _BatchSlot(batch, k))
+                     for k, p in enumerate(payloads))
+            self._queued_total += len(payloads)
+        return fut
+
+    def _refusal(self, group: int) -> Optional[Exception]:
+        """The submission refusal taxonomy, shared by submit/submit_batch
+        (reference: RaftStub.process checks, command/RaftStub.java:79-91)."""
+        if not self.h_active[group]:
+            return ObsoleteContextError(f"group {group} closed")
+        if self.h_role[group] != LEADER:
+            hint = int(self.h_leader[group])
+            return NotLeaderError(group, None if hint == NIL else hint)
+        if not self.h_ready[group]:
+            return NotReadyError(
+                f"group {group}: leader lacks a healthy majority")
+        return None
 
     def is_leader(self, group: int) -> bool:
         return bool(self.h_role[group] == LEADER)
@@ -480,27 +563,38 @@ class RaftNode:
             n_sub = int(sub_acc[g])
             sub_lo = int(sub_start[g])
             leader_src = int(h_leader[g])
-            for idx in range(lo, hi + 1):
-                if n_sub and idx >= sub_lo:
-                    # our own accepted submission: payload from the queue
-                    payload = self._take_submission(g, idx - sub_lo)
-                    term = int(h_term[g])
-                else:
-                    # follower adoption: payload staged with the leader's
-                    # frame; term from the same frame's entry-term vector
-                    # (the message the engine just accepted).
-                    payload = staged_payloads.get((leader_src, g, idx))
-                    term = self._staged_term(inbox_arrays, leader_src, g, idx)
-                    if payload is None or term is None:
-                        # Entry accepted on device but its bytes are not
-                        # locally available (e.g. duplicate-delivery edge).
-                        # Stop at the gap: the durable prefix stays
-                        # contiguous; resend will re-deliver.
-                        break
+            # The written range splits into a follower-adoption prefix and
+            # an own-submission suffix (in practice a tick has one or the
+            # other: adoption needs a non-leader at phase 4, submission a
+            # leader at phase 8).  Staging each range wholesale keeps the
+            # per-entry Python work minimal.
+            adopt_hi = min(hi, sub_lo - 1) if n_sub else hi
+            gap = False
+            for idx in range(lo, adopt_hi + 1):
+                # follower adoption: payload staged with the leader's frame;
+                # term from the same frame's entry-term vector.
+                payload = staged_payloads.get((leader_src, g, idx))
+                term = self._staged_term(inbox_arrays, leader_src, g, idx)
+                if payload is None or term is None:
+                    # Entry accepted on device but its bytes are not
+                    # locally available (e.g. duplicate-delivery edge).
+                    # Stop at the gap: the durable prefix stays contiguous;
+                    # resend will re-deliver.
+                    gap = True
+                    break
                 bat_g.append(g)
                 bat_i.append(idx)
                 bat_t.append(term)
                 bat_p.append(payload)
+            if n_sub and not gap and hi >= sub_lo:
+                # own accepted submissions: payloads from the queue (one
+                # lock acquisition for the whole range), all at our term.
+                cnt = hi - sub_lo + 1
+                own = self._peek_submissions(g, cnt)
+                bat_g.extend([g] * cnt)
+                bat_i.extend(range(sub_lo, hi + 1))
+                bat_t.extend([int(h_term[g])] * cnt)
+                bat_p.extend(own)
             commits.append((g, sub_lo, n_sub))
         if bat_g:
             self.store.append_batch(bat_g, bat_i, bat_t, bat_p)
@@ -533,9 +627,9 @@ class RaftNode:
         for g in rejected.tolist():
             self._reject_submissions(int(g))
 
-    def _take_submission(self, g: int, k: int) -> bytes:
+    def _peek_submissions(self, g: int, n: int) -> List[bytes]:
         with self._submit_lock:
-            return self._submissions[g][k][0]
+            return [p for p, _ in self._submissions[g][:n]]
 
     def _commit_submissions(self, g: int, start_idx: int, n: int) -> None:
         """Register promises for accepted commands and drop them from the
@@ -635,7 +729,8 @@ class RaftNode:
             if p == self.node_id:
                 continue
             fields = {name: arr[p] for name, arr in fields_all.items()}
-            packed = pack_slice(self.node_id, fields, self._payload)
+            packed = pack_slice(self.node_id, fields, self._payload,
+                                self.store.payloads_window)
             if packed is not None:
                 self.transport.send_slice(p, packed)
 

@@ -166,15 +166,29 @@ def pack_snap_chunk(data: bytes) -> bytes:
 
 
 def pack_slice(src: int, fields: Dict[str, np.ndarray],
-               payload_fn: Optional[Callable[[int, int], Optional[bytes]]]
-               ) -> Optional[bytes]:
+               payload_fn: Optional[Callable[[int, int], Optional[bytes]]],
+               payload_window_fn: Optional[Callable[[int, int, int], list]]
+               = None) -> Optional[bytes]:
     """Pack one destination's tick slice into a MSGS frame body.
 
     ``fields`` maps Messages field name -> numpy array of shape [G] or
     [G, B] (this destination's slice of the outbox).  ``payload_fn(g, idx)``
-    supplies AppendEntries command payloads (LogStore.payload).  Returns
-    None when the slice is empty (nothing valid for this peer).
+    supplies AppendEntries command payloads (LogStore.payload);
+    ``payload_window_fn(g, start, n) -> [bytes|None]`` is the batched
+    variant (LogStore.payloads_window) used when provided — one call per
+    column instead of one per entry.  Returns None when the slice is empty
+    (nothing valid for this peer).
     """
+    if payload_window_fn is None:
+        # One resolution path: adapt the per-entry fetcher so the packing
+        # logic below (incl. column-drop-on-missing) has a single
+        # implementation exercised by every caller and test.
+        if payload_fn is not None:
+            payload_window_fn = (lambda g, start, n:
+                                 [payload_fn(g, i)
+                                  for i in range(start, start + n)])
+        else:
+            payload_window_fn = lambda g, start, n: [None] * n
     parts = [struct.pack("<IB", src, len(KIND_FIELDS))]
     n_total = 0
     for kind, (vfield, dfields) in KIND_FIELDS.items():
@@ -192,17 +206,11 @@ def pack_slice(src: int, fields: Dict[str, np.ndarray],
             ns = fields["ae_n"][cols]
             keep, blobs = [], []
             for g, prev, n in zip(cols.tolist(), prevs.tolist(), ns.tolist()):
-                col_blobs = []
-                for idx in range(prev + 1, prev + 1 + n):
-                    p = payload_fn(int(g), int(idx)) \
-                        if payload_fn is not None else None
-                    if p is None:
-                        col_blobs = None
-                        break
-                    col_blobs.append(struct.pack("<I", len(p)) + p)
-                if col_blobs is not None:
-                    keep.append(g)
-                    blobs.extend(col_blobs)
+                win = payload_window_fn(int(g), prev + 1, n) if n else []
+                if any(p is None for p in win):
+                    continue
+                keep.append(g)
+                blobs.extend(struct.pack("<I", len(p)) + p for p in win)
             cols = np.asarray(keep, np.uint32)
             blob_section = b"".join(blobs)
         n_total += len(cols)

@@ -146,3 +146,41 @@ def test_wal_survives_full_cluster_restart(tmp_path):
         assert res == 6
     finally:
         c2.close()
+
+
+def test_submit_batch_resolves_in_order(cluster):
+    c = cluster
+    lead = c.wait_leader(0)
+    n = c.nodes[lead]
+    c.tick_until(lambda: n.is_ready(0), 100, "leader ready")
+    fut = n.submit_batch(0, [f"b-{k}".encode() for k in range(3)])
+    c.tick_until(fut.done, 200, "batch committed")
+    results = fut.result()
+    assert results == sorted(results)  # consecutive indices, in order
+    assert len(results) == 3
+    c.tick(10)
+    c.assert_file_parity(0)
+    # Refusal taxonomy rides the single future.
+    other = next(i for i in range(3) if i != lead)
+    bad = c.nodes[other].submit_batch(0, [b"x"])
+    assert isinstance(bad.exception(), NotLeaderError)
+    empty = n.submit_batch(0, [])
+    assert empty.result() == []
+
+
+def test_submit_batch_fails_wholesale_on_stepdown(cluster):
+    c = cluster
+    lead = c.wait_leader(0)
+    n = c.nodes[lead]
+    c.tick_until(lambda: n.is_ready(0), 100, "leader ready")
+    # Partition the leader so the batch cannot commit (a quorumless leader
+    # keeps leading — correct Raft), then heal: the majority side has moved
+    # to a higher term, the old leader steps down, and the whole batch
+    # future fails with the abort error.
+    c.net.partition([[lead], [i for i in range(3) if i != lead]])
+    fut = n.submit_batch(0, [b"doomed-1", b"doomed-2"])
+    c.tick(40)   # majority side elects a new leader at a higher term
+    assert not fut.done()
+    c.net.heal()
+    c.tick_until(fut.done, 400, "batch aborted on step-down")
+    assert fut.exception() is not None
