@@ -33,6 +33,11 @@ import time
 
 SCALES = (1_024, 4_096, 16_384, 32_768, 65_536, 100_000)
 BASELINE_CPS = 1_000_000  # BASELINE.md: >1M commits/sec @100k groups, v5e-1
+FALSY = ("", "0", "false", "no", "off")
+
+
+def env_flag(name: str) -> bool:
+    return os.environ.get(name, "").strip().lower() not in FALSY
 
 
 def child_run(n_groups: int, measure_ticks: int, warmup_ticks: int,
@@ -64,6 +69,10 @@ def child_run(n_groups: int, measure_ticks: int, warmup_ticks: int,
         log_slots=64, batch=8, max_submit=8,
         election_ticks=10, heartbeat_ticks=3, rpc_timeout_ticks=8,
         pre_vote=True,
+        # BENCH_USE_PALLAS=1: quorum commit through the Pallas kernel
+        # (ops/quorum.py) instead of inline jnp — the A/B the TPU decision
+        # needs is then one env var per run.
+        use_pallas=env_flag("BENCH_USE_PALLAS"),
     )
     # Group-axis tiling: one fused program is proven to 32k groups on TPU
     # and faults at >= 65k (r1), so larger runs tile the group axis into

@@ -61,3 +61,28 @@ class RetryCommandError(RaftError):
 
 class SerializeError(RaftError):
     """Command (de)serialization failed (reference SerializeException)."""
+
+
+class BatchAbortedError(RaftError):
+    """A ``submit_batch`` future failed before every command in the batch
+    resolved.  Carries per-slot outcomes so the client can see exactly
+    which prefix already committed AND applied:
+
+    * ``completed[k]`` True — command k committed and applied;
+      ``results[k]`` holds its apply result.
+    * ``completed[k]`` False — UNKNOWN: the command may still commit
+      cluster-wide (the standard Raft client ambiguity on leader change —
+      the same contract as a per-command NotLeader abort).  Blind
+      resubmission can double-apply on a non-idempotent machine; re-check
+      state or use idempotent/unique commands.
+
+    ``cause`` is the underlying refusal (NotLeaderError, ObsoleteContext…).
+    """
+
+    def __init__(self, cause: Exception, results: list, completed: list):
+        done = sum(1 for c in completed if c)
+        super().__init__(
+            f"batch aborted after {done}/{len(completed)} applied: {cause}")
+        self.cause = cause
+        self.results = results
+        self.completed = completed

@@ -143,9 +143,18 @@ def quorum_commit(cfg, match_full, log, commit, term, can_lead):
     from ..core.step import ring_term_at
 
     if getattr(cfg, "use_pallas", False):
+        import os
         state_vec = jnp.stack(
             [commit, term, can_lead.astype(I32)])
-        interpret = jax.default_backend() != "tpu"
+        # Compile the kernel on real TPU backends; interpret elsewhere.
+        # RAFT_PALLAS_INTERPRET=0/1 overrides — the bench host's TPU plugin
+        # registers as platform 'axon', which a name check alone would
+        # misclassify as not-a-TPU and silently run in interpret mode.
+        env = os.environ.get("RAFT_PALLAS_INTERPRET", "").strip().lower()
+        if env:
+            interpret = env not in ("0", "false", "no", "off")
+        else:
+            interpret = jax.default_backend() not in ("tpu", "axon")
         return quorum_commit_pallas(
             match_full, log.term, log.base, log.base_term, log.last,
             state_vec, cfg.majority, interpret)

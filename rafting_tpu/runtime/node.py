@@ -54,7 +54,8 @@ from ..snapshot.policy import MaintainAgreement
 from ..transport import InboxAccumulator, messages_template
 from ..transport.codec import pack_slice
 from ..api.anomaly import (
-    BusyLoopError, NotLeaderError, NotReadyError, ObsoleteContextError,
+    BatchAbortedError, BusyLoopError, NotLeaderError, NotReadyError,
+    ObsoleteContextError,
 )
 from ..utils.metrics import Metrics
 from ..utils.profiling import TickProfiler
@@ -68,24 +69,29 @@ class BatchSubmit:
     ``Future`` cost — a ``threading.Condition`` allocation per command was
     the top client-side cost under dense load.  Completion/failure happen
     on the tick thread only (the dispatcher's single-writer rule), so no
-    extra locking is needed."""
+    extra locking is needed.  On failure the future raises
+    ``BatchAbortedError`` carrying per-slot outcomes, so an already
+    committed-and-applied prefix is never silently discarded."""
 
-    __slots__ = ("future", "results", "_remaining")
+    __slots__ = ("future", "results", "completed", "_remaining")
 
     def __init__(self, n: int):
         self.future: Future = Future()
         self.results: list = [None] * n
+        self.completed: list = [False] * n
         self._remaining = n
 
     def _complete(self, k: int, result) -> None:
         self.results[k] = result
+        self.completed[k] = True
         self._remaining -= 1
         if self._remaining == 0 and not self.future.done():
             self.future.set_result(self.results)
 
     def _fail(self, err: Exception) -> None:
         if not self.future.done():
-            self.future.set_exception(err)
+            self.future.set_exception(BatchAbortedError(
+                err, list(self.results), list(self.completed)))
 
 
 class _BatchSlot:
@@ -316,16 +322,18 @@ class RaftNode:
         reported on the single future; one queue-capacity check and one
         lock acquisition cover the whole batch.  If any command in the
         batch fails (NotLeader on step-down, ObsoleteContext, snapshot
-        jump), the whole batch's future fails — clients treat it like a
-        per-command error and re-check/resubmit."""
+        jump), the future raises :class:`BatchAbortedError`, whose
+        ``completed``/``results`` report exactly which prefix already
+        committed and applied — do NOT blindly resubmit the whole batch
+        (see the error's docstring for the client contract)."""
         batch = BatchSubmit(len(payloads))
         fut = batch.future
-        if not payloads:
-            fut.set_result([])
-            return fut
         err = self._refusal(group)
         if err is not None:
             fut.set_exception(err)
+            return fut
+        if not payloads:
+            fut.set_result([])
             return fut
         with self._submit_lock:
             q = self._submissions.setdefault(group, [])

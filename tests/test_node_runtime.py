@@ -183,4 +183,10 @@ def test_submit_batch_fails_wholesale_on_stepdown(cluster):
     assert not fut.done()
     c.net.heal()
     c.tick_until(fut.done, 400, "batch aborted on step-down")
-    assert fut.exception() is not None
+    from rafting_tpu.api.anomaly import BatchAbortedError
+    err = fut.exception()
+    assert isinstance(err, BatchAbortedError)
+    # Nothing could commit through a quorumless leader: no slot completed,
+    # and the cause is the step-down refusal.
+    assert err.completed == [False, False]
+    assert err.cause is not None
