@@ -146,21 +146,31 @@ def quorum_commit(cfg, match_full, log, commit, term, can_lead):
         import os
         state_vec = jnp.stack(
             [commit, term, can_lead.astype(I32)])
-        # Compile the kernel on real TPU backends; interpret elsewhere.
-        # RAFT_PALLAS_INTERPRET=0/1 overrides — the bench host's TPU plugin
-        # registers as platform 'axon', which a name check alone would
-        # misclassify as not-a-TPU and silently run in interpret mode.
+        # Interpret only on the CPU backend; any accelerator attempts the
+        # compiled lowering (an unsupported backend then fails LOUDLY
+        # instead of silently running the interpreter at 1000x cost — the
+        # trap a TPU-plugin-name allowlist would re-arm every time a
+        # plugin registers under a new name, e.g. the bench host's 'axon').
+        # RAFT_PALLAS_INTERPRET=0/1 overrides either way.
         env = os.environ.get("RAFT_PALLAS_INTERPRET", "").strip().lower()
         if env:
             interpret = env not in ("0", "false", "no", "off")
         else:
-            interpret = jax.default_backend() not in ("tpu", "axon")
+            interpret = jax.default_backend() == "cpu"
         return quorum_commit_pallas(
             match_full, log.term, log.base, log.base_term, log.last,
             state_vec, cfg.majority, interpret)
     P = match_full.shape[1]
-    sorted_m = jnp.sort(match_full, axis=1)
-    quorum_idx = sorted_m[:, P - cfg.majority]
+    if P == 3 and cfg.majority == 2:
+        # 3-peer fast path: the quorum index is the median — three
+        # min/max ops instead of a sort (the overwhelmingly common
+        # cluster size; reference test clusters are all 3-node).
+        a, b, c = match_full[:, 0], match_full[:, 1], match_full[:, 2]
+        quorum_idx = jnp.maximum(jnp.minimum(a, b),
+                                 jnp.minimum(jnp.maximum(a, b), c))
+    else:
+        sorted_m = jnp.sort(match_full, axis=1)
+        quorum_idx = sorted_m[:, P - cfg.majority]
     can = can_lead & (quorum_idx > commit) & \
         (ring_term_at(log, quorum_idx) == term)
     return jnp.where(can, quorum_idx, commit)
