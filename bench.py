@@ -64,9 +64,16 @@ def child_run(n_groups: int, measure_ticks: int, warmup_ticks: int,
     init_s = time.perf_counter() - t_init
 
     n_peers = 3
+    # Pipeline budget knobs.  Defaults are the proven-on-TPU envelope
+    # (r1 data was taken at L=64/B=8); the CPU fallback overrides them to
+    # the tuned point from the 32k-group sweep (S=32/B=32/L=256 ~ 2.1x —
+    # the reference itself ships up to 50 entries per AppendEntries,
+    # Leadership.java REPLICATE_LIMIT).
     cfg = EngineConfig(
         n_groups=n_groups, n_peers=n_peers,
-        log_slots=64, batch=8, max_submit=8,
+        log_slots=int(os.environ.get("BENCH_LOG_SLOTS", "64")),
+        batch=int(os.environ.get("BENCH_BATCH", "8")),
+        max_submit=int(os.environ.get("BENCH_MAX_SUBMIT", "8")),
         election_ticks=10, heartbeat_ticks=3, rpc_timeout_ticks=8,
         pre_vote=True,
         # BENCH_USE_PALLAS=1: quorum commit through the Pallas kernel
@@ -150,12 +157,15 @@ def emit(line: dict) -> None:
 
 def run_scale(n_groups: int, measure_ticks: int, warmup_ticks: int,
               timeout_s: float, platform: str = "",
-              profile_dir: str = "") -> dict | None:
+              profile_dir: str = "", extra_env: dict | None = None
+              ) -> dict | None:
     """Run one scale in a subprocess; return its result dict or None."""
     cmd = [sys.executable, os.path.abspath(__file__), "--child",
            str(n_groups), str(measure_ticks), str(warmup_ticks), platform,
            profile_dir]
     env = dict(os.environ)
+    if extra_env:
+        env.update(extra_env)
     env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__)) + (
         os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
     if not platform:
@@ -229,7 +239,16 @@ def main() -> None:
                 fb_scale = only if only else 100_000
                 fb_timeout = max(
                     60, min(300, budget - (time.monotonic() - t_start)))
-                res = run_scale(fb_scale, 96, 48, fb_timeout, platform="cpu")
+                # Tuned pipeline budget, applied all-or-nothing: mixing
+                # tuned values with operator-pinned ones could produce an
+                # invalid hybrid (e.g. batch > log_slots) and kill the
+                # last-resort fallback.
+                knobs = ("BENCH_MAX_SUBMIT", "BENCH_BATCH",
+                         "BENCH_LOG_SLOTS")
+                tuned = ({} if any(k in os.environ for k in knobs)
+                         else dict(zip(knobs, ("32", "32", "256"))))
+                res = run_scale(fb_scale, 96, 48, fb_timeout, platform="cpu",
+                                extra_env=tuned)
                 if res is not None:
                     best = res
                     emit(headline(best, fallback=True))
