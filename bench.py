@@ -34,6 +34,11 @@ import time
 SCALES = (1_024, 4_096, 16_384, 32_768, 65_536, 100_000)
 BASELINE_CPS = 1_000_000  # BASELINE.md: >1M commits/sec @100k groups, v5e-1
 FALSY = ("", "0", "false", "no", "off")
+# The tuned pipeline budget (32k-group sweep; see git log) — one definition
+# shared by the fallback and bonus stages so they cannot drift.
+TUNED_ENV = {"BENCH_MAX_SUBMIT": "32", "BENCH_BATCH": "32",
+             "BENCH_LOG_SLOTS": "256"}
+TUNED_TAG = " [tuned budget S=32/B=32/L=256]"
 
 
 def env_flag(name: str) -> bool:
@@ -138,10 +143,11 @@ def child_run(n_groups: int, measure_ticks: int, warmup_ticks: int,
     }
 
 
-def headline(res: dict, fallback: bool = False) -> dict:
+def headline(res: dict, fallback: bool = False, tuned: bool = False) -> dict:
     plat = res["platform"]
     tag = "" if plat == "cpu" else " on device"
     note = " [CPU FALLBACK — device unreachable]" if fallback else ""
+    note += TUNED_TAG if tuned else ""
     return {
         "metric": f"AppendEntries commits/sec @{res['scale'] // 1000}k Raft "
                   f"groups (3-node cluster, full consensus loop{tag}){note}",
@@ -219,6 +225,7 @@ def main() -> None:
     t_start = time.monotonic()
 
     best = None
+    best_is_tuned = False
     for i, g in enumerate(scales):
         is_smoke = (i == 0 and only is None)
         timeout_s = smoke_timeout if i == 0 else scale_timeout
@@ -243,15 +250,14 @@ def main() -> None:
                 # tuned values with operator-pinned ones could produce an
                 # invalid hybrid (e.g. batch > log_slots) and kill the
                 # last-resort fallback.
-                knobs = ("BENCH_MAX_SUBMIT", "BENCH_BATCH",
-                         "BENCH_LOG_SLOTS")
-                tuned = ({} if any(k in os.environ for k in knobs)
-                         else dict(zip(knobs, ("32", "32", "256"))))
+                tuned = ({} if any(k in os.environ for k in TUNED_ENV)
+                         else TUNED_ENV)
                 res = run_scale(fb_scale, 96, 48, fb_timeout, platform="cpu",
                                 extra_env=tuned)
                 if res is not None:
                     best = res
-                    emit(headline(best, fallback=True))
+                    best_is_tuned = bool(tuned)
+                    emit(headline(best, fallback=True, tuned=bool(tuned)))
                 break
             # A mid-ladder failure costs that scale only (bounded by its
             # timeout): larger scales may still succeed.
@@ -266,6 +272,23 @@ def main() -> None:
                         "device and CPU fallback both failed)",
               "value": 0, "unit": "commits/sec", "vs_baseline": 0.0})
         sys.exit(1)
+
+    # Bonus stage: the conservative number is banked; if the top scale
+    # passed (device OR a healthy CPU-only ladder), try once more with the
+    # tuned pipeline budget (2x+ on CPU) and publish whichever is better,
+    # tagged so the artifact records which config produced it.
+    remaining = budget - (time.monotonic() - t_start)
+    if (best["scale"] == scales[-1] and only is None and not best_is_tuned
+            and remaining > scale_timeout * 0.5
+            and not any(k in os.environ for k in TUNED_ENV)):
+        ticks, warmup = (512, 128) if best["platform"] != "cpu" else (96, 48)
+        res = run_scale(best["scale"], ticks, warmup,
+                        min(scale_timeout, remaining),
+                        profile_dir=profile_dir, extra_env=TUNED_ENV)
+        if res is not None and res["cps"] > best["cps"]:
+            sys.stderr.write(f"[bench] tuned budget: {res['cps']:,.0f} "
+                             "commits/s\n")
+            emit(headline(res, tuned=True))
 
 
 if __name__ == "__main__":
