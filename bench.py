@@ -96,25 +96,57 @@ def child_run(n_groups: int, measure_ticks: int, warmup_ticks: int,
         block = -(-n_groups // n_blocks)  # equal blocks, minimal padding
         run_ticks = _partial(run_cluster_ticks_blocked, group_block=block)
     else:
+        n_blocks = 1
         block = 0
         run_ticks = run_cluster_ticks
     c = DeviceCluster(cfg, seed=0)
     submit = jnp.full((n_peers, n_groups), cfg.max_submit, jnp.int32)
 
+    # Execution granularity: the scan length per device execution.  Two
+    # r4 findings (tools/bisect_tpu.py) force this structure:
+    # * one LONG execution (512 ticks at >= 65k groups, ~2+ min device
+    #   time) dies with UNAVAILABLE — the r1 ">= 65k kernel fault" was a
+    #   per-execution duration limit, NOT a scale limit: the same shapes
+    #   complete as back-to-back 128-tick executions;
+    # * jax.block_until_ready is a NO-OP on the tunneled TPU platform
+    #   ('axon'), so timing must be fenced by a real device->host read.
+    # Default chunk scales inversely with the block count (lax.map runs
+    # blocks sequentially INSIDE one execution), keeping per-execution
+    # device work near the proven envelope of a 128-tick 32k-group run.
+    default_chunk = max(16, 128 // n_blocks)
+    chunk = max(1, min(int(os.environ.get("BENCH_TICKS_PER_CALL",
+                                          str(default_chunk))),
+                       measure_ticks))
+
+    def run_chunks(n_ticks, states, inflight, info):
+        done = 0
+        while done < n_ticks:
+            step = min(chunk, n_ticks - done)
+            states, inflight, info = run_ticks(
+                cfg, step, states, inflight, info, c.conn, submit)
+            done += step
+        return states, inflight, info
+
+    def commit_sum(states):
+        # Device->host read: the ONLY reliable execution fence here.
+        return int(np.asarray(states.commit).max(axis=0)
+                   .astype(np.int64).sum())
+
     # Warm-up: compile + elect leaders + reach steady-state replication.
     t0 = time.perf_counter()
-    states, inflight, info = run_ticks(
-        cfg, warmup_ticks, c.states, c.inflight, c.last_info, c.conn, submit)
-    jax.block_until_ready(states.commit)
+    states, inflight, info = run_chunks(warmup_ticks, c.states, c.inflight,
+                                        c.last_info)
+    start_commit = commit_sum(states)
     warm_s = time.perf_counter() - t0
-    start_commit = int(np.asarray(states.commit).max(axis=0).astype(np.int64).sum())
 
     def measure():
         nonlocal states, inflight, info
         t0 = time.perf_counter()
-        states, inflight, info = run_ticks(
-            cfg, measure_ticks, states, inflight, info, c.conn, submit)
-        jax.block_until_ready(states.commit)
+        states, inflight, info = run_chunks(measure_ticks, states, inflight,
+                                            info)
+        # The commit read fences the elapsed time; its cost ([N, G] i32
+        # pull) is part of the measurement and negligible at every scale.
+        commit_sum(states)
         return time.perf_counter() - t0
 
     from rafting_tpu.utils.profiling import device_trace

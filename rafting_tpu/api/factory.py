@@ -32,6 +32,14 @@ class RaftFactory:
         return FileMachineProvider(
             os.path.join(config.data_dir, "machines"))
 
+    def log_store(self, config: RaftConfig, node_id: int):
+        """Build the durable log tier (reference RaftFactory.loadState,
+        support/RaftFactory.java:18; SPI contract in log/spi.py).  Override
+        to swap the storage engine — e.g. ``MemoryLogStore`` for tests or
+        an alternative durability tier.  Return None to let RaftNode build
+        the default WAL under its data dir."""
+        return None
+
     def transport_factory(self, config: RaftConfig) -> Callable:
         peers = dict(enumerate(config.node_addresses()))
 
@@ -59,4 +67,5 @@ class RaftFactory:
             group_queue_cap=config.group_queue_cap,
             total_queue_cap=config.total_queue_cap,
             busy_threshold=config.busy_threshold,
+            store=self.log_store(config, node_id),
         )

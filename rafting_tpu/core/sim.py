@@ -110,7 +110,11 @@ def run_cluster_ticks_blocked(cfg: EngineConfig, n_ticks: int,
     Per-block PRNG keys are folded with the block index so election jitter
     stays decorrelated across blocks.  Not bit-identical to the unblocked
     run (randomized timeouts are drawn per-block), but protocol-equivalent;
-    use the unblocked path when exact parity matters.
+    use the unblocked path when exact parity matters.  The returned state's
+    ``rng`` is block 0's folded key (block-invariant leaves collapse to
+    block 0), so chaining blocked and unblocked runs changes the
+    randomized-timeout stream — fine for throughput runs, not for
+    reproducibility-sensitive callers.
     """
     G = cfg.n_groups
     if group_block >= G:

@@ -137,6 +137,7 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     log = s.log
     next_idx, match_idx = s.next_idx, s.match_idx
     send_next, inflight = s.send_next, s.inflight
+    hb_inflight = s.hb_inflight
     sent_at, need_snap = s.sent_at, s.need_snap
     ok_at, fail_at, fail_streak = s.ok_at, s.fail_at, s.fail_streak
     votes, prevotes = s.votes, s.prevotes
@@ -239,6 +240,7 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     match_idx = jnp.where(vote_win[:, None], 0, match_idx)
     send_next = jnp.where(vote_win[:, None], log.last[:, None] + 1, send_next)
     inflight = jnp.where(vote_win[:, None], 0, inflight)
+    hb_inflight = jnp.where(vote_win[:, None], 0, hb_inflight)
     need_snap = jnp.where(vote_win[:, None], False, need_snap)
     ok_at = jnp.where(vote_win[:, None], 0, ok_at)
     fail_at = jnp.where(vote_win[:, None], 0, fail_at)
@@ -320,6 +322,10 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     out_aer_match = jnp.where(
         is_sel & acc[None, :], tail[None, :],
         jnp.minimum(log.last[None, :], inbox.ae_prev_idx - 1))
+    # Echo whether the AE was empty (a heartbeat): its sender did not
+    # charge the reply against the in-flight window (phase 9), so it must
+    # not decrement it either.
+    out_aer_empty = ae_v & (inbox.ae_n == 0)
 
     # ---- 5. InstallSnapshot ------------------------------------------------
     # Device plane: an offer merely tells the follower's host to start the
@@ -352,6 +358,10 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     out_isr_valid = is_v
     out_isr_term = jnp.broadcast_to(term[None, :], (P, G))
     out_isr_success = is_sel_snap & covered[None, :]
+    # Echo the window-exemption flag: a reply to a heartbeat-cadence
+    # re-offer must not release a slot the offer never took (symmetric
+    # with aer_empty).
+    out_isr_probe = is_v & inbox.is_probe
 
     # Host finished installing a snapshot: adopt the milestone as the new
     # log floor.  InstallSnapshot receiver rule (Raft fig. 13): if we hold an
@@ -396,12 +406,20 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     need_snap = jnp.where(aer_r, aer_fail & (nx <= log.base[:, None]),
                           need_snap)
     next_idx = jnp.maximum(nx, log.base[:, None] + 1)
-    # Pipeline accounting: each reply acks one in-flight batch; a rejection
-    # aborts the whole window so replication resumes from the clamped
-    # next_idx (reference: nextIndex rollback cancels optimistic sends,
-    # Leadership.updateIndex:75-114).
-    inflight = jnp.where(aer_r, jnp.maximum(inflight - 1, 0), inflight)
+    # Pipeline accounting: data-batch replies release a data slot,
+    # heartbeat replies (echoed as aer_empty) release a heartbeat slot —
+    # the two occupancy lanes never cross, so the window count stays
+    # exact even though window-full heartbeats go out slot-exempt (phase
+    # 9).  A rejection aborts the whole window so replication resumes
+    # from the clamped next_idx (reference: nextIndex rollback cancels
+    # optimistic sends, Leadership.updateIndex:75-114).
+    aer_ack = aer_r & ~inbox.aer_empty.T
+    aer_hb_ack = aer_r & inbox.aer_empty.T
+    inflight = jnp.where(aer_ack, jnp.maximum(inflight - 1, 0), inflight)
+    hb_inflight = jnp.where(aer_hb_ack, jnp.maximum(hb_inflight - 1, 0),
+                            hb_inflight)
     inflight = jnp.where(aer_fail, 0, inflight)
+    hb_inflight = jnp.where(aer_fail, 0, hb_inflight)
     send_next = jnp.where(aer_fail, next_idx, send_next)
     # Health evidence: any reply — grant or rejection — proves the peer
     # reachable (reference statSuccess on every response incl. rejects,
@@ -422,7 +440,10 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
                          next_idx)
     match_idx = jnp.where(isr_ok, jnp.maximum(match_idx, log.base[:, None]),
                           match_idx)
-    inflight = jnp.where(isr_r, jnp.maximum(inflight - 1, 0), inflight)
+    # Only replies to WINDOW-OCCUPYING offers release a slot (probe
+    # re-offers are echoed as isr_probe — symmetric with aer_empty).
+    isr_ack = isr_r & ~inbox.isr_probe.T
+    inflight = jnp.where(isr_ack, jnp.maximum(inflight - 1, 0), inflight)
     ok_at = jnp.where(isr_r, now, ok_at)
     fail_streak = jnp.where(isr_r, 0, fail_streak)
     # The pipeline head never trails the ack base.
@@ -476,12 +497,21 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     # for the health stats (reference statFailure on unreachable,
     # Leadership.java:65-73) + window reset so replication restarts from the
     # ack base (reference AsyncFuture timeout, Async.java:177-256).
-    timed_out = lead_peer & (inflight > 0) & \
+    # RPC timeout — the ONLY failure-evidence source, anchored to OUR OWN
+    # last send on OUR OWN tick clock (reference: per-request Async
+    # timeout feeding statFailure, Async.java:177-256, Leadership.java:
+    # 65-73).  Occupying heartbeats (below) keep this armed on idle
+    # leaders: a dead peer accumulates un-acked heartbeats and times out
+    # exactly like a lost data window.  No reply-staleness heuristics —
+    # they false-positive under free-running tick drift and wedge the
+    # readiness gate shut via the recovery cool-down.
+    timed_out = lead_peer & (inflight + hb_inflight > 0) & \
         (now - sent_at >= cfg.rpc_timeout_ticks)
     fail_streak = jnp.where(timed_out, fail_streak + 1, fail_streak)
     fail_at = jnp.where(timed_out, now, fail_at)
     send_next = jnp.where(timed_out, next_idx, send_next)
     inflight = jnp.where(timed_out, 0, inflight)
+    hb_inflight = jnp.where(timed_out, 0, hb_inflight)
 
     heartbeat = (role == LEADER) & (now >= hb_due)
     has_data = (log.last[:, None] >= send_next) & ~need_snap
@@ -492,10 +522,22 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     # batches arrive first — guaranteed by the transport's per-source
     # in-order delivery (transport/inbox.py); under loss the follower
     # rejects and the window resets, same as any failed AE.
-    can_send = inflight < cfg.inflight_limit
+    can_send = (inflight + hb_inflight) < cfg.inflight_limit
     send_data = lead_peer & ~need_snap & has_data & can_send
-    send_hb = (lead_peer & ~need_snap & heartbeat[:, None] & ~has_data &
-               can_send)
+    # Heartbeat capacity reservation (reference: the in-flight budget is
+    # divided for heartbeats so they keep flowing, Leader.java:162,
+    # Leadership.java:10-11): an empty AE goes out on the heartbeat cadence
+    # on every leader lane not shipping data this tick — INCLUDING lanes
+    # whose window is full of lost batches, so a wedged window can never
+    # starve the followers' election timers into a spurious election (any
+    # valid AE at the leader's term resets the timer, phase 4).  While the
+    # window has room the heartbeat OCCUPIES a slot (in the dedicated
+    # hb_inflight lane, released by its aer_empty-echoed reply), which is
+    # what arms the RPC-timeout failure detector on idle leaders; when the
+    # window is full it goes out slot-exempt, keeping followers fed while
+    # the stuck batches carry the timeout evidence.
+    send_hb = lead_peer & ~need_snap & heartbeat[:, None] & ~send_data
+    hb_occupy = send_hb & can_send
     send_ae = send_data | send_hb                                # [G, P]
     n_send = jnp.where(send_data, n_avail, 0)
     prev = send_next - 1
@@ -511,16 +553,27 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     out_ae_n = n_send.T
     out_ae_ents = jnp.swapaxes(ents_all, 0, 1)                   # [P, G, B]
     # Snapshot offer for laggards (reference Leader.java:168-190); occupies
-    # the whole window (one offer at a time, re-offered after reply/timeout).
-    send_is = lead_peer & need_snap & (inflight == 0)
+    # the whole window (one offer at a time), re-offered on the heartbeat
+    # cadence while un-acked — the re-offer is window-exempt like a
+    # heartbeat (reference: the heartbeat replicateLog pass re-enters the
+    # install branch, Leader.java:162-190), so the follower's election
+    # timer stays fed through a long download even if offer acks are lost.
+    send_is_win = lead_peer & need_snap & (inflight + hb_inflight == 0)
+    send_is = send_is_win | (lead_peer & need_snap & heartbeat[:, None])
     out_is_valid = send_is.T
     out_is_term = jnp.broadcast_to(term[None, :], (P, G))
     out_is_idx = jnp.broadcast_to(log.base[None, :], (P, G))
     out_is_last_term = jnp.broadcast_to(log.base_term[None, :], (P, G))
-    sent = send_ae | send_is
+    out_is_probe = (send_is & ~send_is_win).T
+    # Window accounting: data batches and the first snapshot offer occupy
+    # data slots; in-window heartbeats occupy heartbeat slots; window-full
+    # heartbeats and snapshot re-offers are slot-exempt (see above).  Any
+    # occupying send refreshes the send clock.
+    occupy = send_data | send_is_win
     send_next = jnp.where(send_data, send_next + n_send, send_next)
-    inflight = jnp.where(sent, inflight + 1, inflight)
-    sent_at = jnp.where(sent, now, sent_at)
+    inflight = jnp.where(occupy, inflight + 1, inflight)
+    hb_inflight = jnp.where(hb_occupy, hb_inflight + 1, hb_inflight)
+    sent_at = jnp.where(occupy | hb_occupy, now, sent_at)
     hb_due = jnp.where(heartbeat, now + cfg.heartbeat_ticks, hb_due)
 
     # Leader readiness (reference Leader.isReady, Leader.java:52-64 +
@@ -568,7 +621,8 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         term=term, role=role, voted_for=voted, leader_id=leader_id,
         commit=commit, applied=s.applied, log=log,
         next_idx=next_idx, match_idx=match_idx, send_next=send_next,
-        inflight=inflight, sent_at=sent_at, need_snap=need_snap,
+        inflight=inflight, hb_inflight=hb_inflight, sent_at=sent_at,
+        need_snap=need_snap,
         ok_at=ok_at, fail_at=fail_at, fail_streak=fail_streak,
         votes=votes, prevotes=prevotes,
         elect_deadline=elect_dl, hb_due=hb_due,
@@ -579,6 +633,7 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         ae_commit=out_ae_commit, ae_n=out_ae_n, ae_ents=out_ae_ents,
         aer_valid=out_aer_valid, aer_term=out_aer_term,
         aer_success=out_aer_success, aer_match=out_aer_match,
+        aer_empty=out_aer_empty,
         rv_valid=out_rv_valid, rv_term=out_rv_term,
         rv_last_idx=out_rv_last_idx, rv_last_term=out_rv_last_term,
         rv_prevote=out_rv_prevote,
@@ -586,9 +641,9 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         rvr_granted=out_rvr_granted, rvr_prevote=out_rvr_prevote,
         rvr_echo=out_rvr_echo,
         is_valid=out_is_valid, is_term=out_is_term, is_idx=out_is_idx,
-        is_last_term=out_is_last_term,
+        is_last_term=out_is_last_term, is_probe=out_is_probe,
         isr_valid=out_isr_valid, isr_term=out_isr_term,
-        isr_success=out_isr_success,
+        isr_success=out_isr_success, isr_probe=out_isr_probe,
     )
     info = StepInfo(
         submit_start=sub_start, submit_acc=n_acc, dirty=dirty,

@@ -39,6 +39,22 @@ NIL = -1  # "no vote" / "no leader" sentinel (reference: votedFor == null)
 
 I32 = jnp.int32
 
+# Every index/term/clock lane is int32 BY DESIGN: the TPU vector units are
+# 32-bit native (int64 is emulated as register pairs and halves throughput
+# of exactly the hot lanes — match/next matrices, the log ring, the tick
+# clock), and the reference's own RocksDB tier is the only 64-bit surface
+# (8-byte big-endian keys, command/storage/RocksLog.java:259-280) — which
+# the host WAL mirrors (u64 indices on disk).  The engine therefore bounds
+# per-group log indices, terms and the tick clock at I32_SAFE_MAX; the host
+# runtime checks the live maxima every tick and fails LOUDLY with
+# ~2^20 ticks of headroom instead of wrapping silently.  At the design
+# point (max_submit <= 32 entries/group/tick, 50 ticks/s) a single group
+# crosses the bound after ~15 days of saturated writes — and the snapshot +
+# lane-purge cycle (admin destroy/recreate, which resets the lane to index
+# 0) is the intended long-horizon story, exactly like the reference's
+# compaction floor keeps RocksDB keys bounded.
+I32_SAFE_MAX = (1 << 31) - (1 << 20)
+
 
 @dataclasses.dataclass(frozen=True)
 class EngineConfig:
@@ -136,6 +152,11 @@ class RaftState:
                               #   is in flight — reference IN_FLIGHT_LIMIT
                               #   pipelining, Leadership.java:11)
     inflight: jax.Array       # [G, P] int32 — un-acked AppendEntries batches
+    hb_inflight: jax.Array    # [G, P] int32 — un-acked OCCUPYING heartbeats
+                              #   (empty AEs sent while the window had room;
+                              #   aer_empty replies decrement THIS lane, so
+                              #   window accounting stays exact — see step.py
+                              #   phase 9)
     sent_at: jax.Array        # [G, P] int32 — tick of last send (for re-send timeout)
     need_snap: jax.Array      # [G, P] bool — follower fell behind compaction floor
                               #   (reference pendingInstallation, Leadership.java:111-113)
@@ -184,6 +205,10 @@ class Messages:
     aer_term: jax.Array      # [P, G] int32
     aer_success: jax.Array   # [P, G] bool
     aer_match: jax.Array     # [P, G] int32 — match index on success, nextIndex-1 hint on failure
+    aer_empty: jax.Array     # [P, G] bool — reply to an EMPTY AE (heartbeat):
+                             #   window-exempt on the sender, so the leader
+                             #   skips the inflight decrement (exact window
+                             #   accounting; see step.py phase 9)
 
     # RequestVote / PreVote request (reference Follower.prepareElection,
     # Candidate.startElection)
@@ -209,9 +234,13 @@ class Messages:
     is_term: jax.Array       # [P, G] int32
     is_idx: jax.Array        # [P, G] int32 — snapshot last index
     is_last_term: jax.Array  # [P, G] int32 — snapshot last term
+    is_probe: jax.Array      # [P, G] bool — window-exempt re-offer (heartbeat
+                             #   cadence): echoed back so the reply does not
+                             #   release a slot the offer never took
     isr_valid: jax.Array     # [P, G] bool
     isr_term: jax.Array      # [P, G] int32
     isr_success: jax.Array   # [P, G] bool
+    isr_probe: jax.Array     # [P, G] bool — echo of is_probe
 
     @classmethod
     def empty(cls, cfg: EngineConfig) -> "Messages":
@@ -223,14 +252,15 @@ class Messages:
             ae_prev_term=z(P, G), ae_commit=z(P, G), ae_n=z(P, G),
             ae_ents=z(P, G, B),
             aer_valid=f(P, G), aer_term=z(P, G), aer_success=f(P, G),
-            aer_match=z(P, G),
+            aer_match=z(P, G), aer_empty=f(P, G),
             rv_valid=f(P, G), rv_term=z(P, G), rv_last_idx=z(P, G),
             rv_last_term=z(P, G), rv_prevote=f(P, G),
             rvr_valid=f(P, G), rvr_term=z(P, G), rvr_granted=f(P, G),
             rvr_prevote=f(P, G), rvr_echo=z(P, G),
             is_valid=f(P, G), is_term=z(P, G), is_idx=z(P, G),
-            is_last_term=z(P, G),
+            is_last_term=z(P, G), is_probe=f(P, G),
             isr_valid=f(P, G), isr_term=z(P, G), isr_success=f(P, G),
+            isr_probe=f(P, G),
         )
 
 
@@ -333,6 +363,7 @@ def init_state(cfg: EngineConfig, node_id: int, seed: int = 0,
         match_idx=z(G, P),
         send_next=jnp.ones((G, P), I32),
         inflight=z(G, P),
+        hb_inflight=z(G, P),
         sent_at=z(G, P),
         need_snap=jnp.zeros((G, P), jnp.bool_),
         ok_at=z(G, P),

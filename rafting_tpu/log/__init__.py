@@ -11,3 +11,5 @@ the TPU-native replacement for the reference's per-group RocksDB stores
 
 from .wal import WalStore, native_available  # noqa: F401
 from .store import LogStore  # noqa: F401
+from .spi import LogStoreSPI  # noqa: F401
+from .memstore import MemoryLogStore  # noqa: F401

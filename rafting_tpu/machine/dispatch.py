@@ -43,7 +43,11 @@ class ApplyDispatcher:
         self._payload_window = payload_window_fn
         self._machines: Dict[int, RaftMachine] = {}
         self._halted: Dict[int, bool] = {}
-        self._promises: Dict[tuple, Future] = {}
+        # Promises keyed group -> {index -> Future}: the apply loop skips
+        # promise bookkeeping entirely for groups with none registered
+        # (every group on a follower node), and abort scans one group's
+        # map, not every promise on the node.
+        self._promises: Dict[int, Dict[int, Future]] = {}
         self._on_applied = on_applied
         self._retry_counts: Dict[tuple, int] = {}
         # Numpy mirror of every machine's last_applied: advance() visits
@@ -80,15 +84,16 @@ class ApplyDispatcher:
         """A client command was accepted at (g, index); complete its future
         with the apply result (reference: RaftContext promise map keyed by
         EntryKey, context/RaftContext.java:223-237)."""
-        self._promises[(g, index)] = fut
+        self._promises.setdefault(g, {})[index] = fut
 
     def abort_promises(self, g: int, err: Exception) -> None:
         """Leadership lost: fail outstanding promises (reference
         Leader ctor abortPromise, context/RaftContext.java:165-187)."""
-        for key in [k for k in self._promises if k[0] == g]:
-            f = self._promises.pop(key)
-            if not f.done():
-                f.set_exception(err)
+        pg = self._promises.pop(g, None)
+        if pg:
+            for f in pg.values():
+                if not f.done():
+                    f.set_exception(err)
 
     # -- snapshot halt/resume ------------------------------------------------
 
@@ -121,12 +126,13 @@ class ApplyDispatcher:
         self.machine(g).recover(checkpoint)
         if self._applied_arr is not None and g < len(self._applied_arr):
             self._applied_arr[g] = self.machine(g).last_applied()
-        for key in [k for k in self._promises
-                    if k[0] == g and k[1] <= checkpoint.index]:
-            f = self._promises.pop(key)
-            if not f.done():
-                f.set_exception(RuntimeError(
-                    "entry applied via snapshot; result unavailable"))
+        pg = self._promises.get(g)
+        if pg:
+            for idx in [i for i in pg if i <= checkpoint.index]:
+                f = pg.pop(idx)
+                if not f.done():
+                    f.set_exception(RuntimeError(
+                        "entry applied via snapshot; result unavailable"))
         self._halted[g] = False
 
     # -- the apply loop -----------------------------------------------------
@@ -145,11 +151,14 @@ class ApplyDispatcher:
             gs = np.nonzero(groups & behind)[0]
         else:
             gs = groups
+        retries = self._retry_counts
         for g in gs:
             g = int(g)
             if self._halted.get(g):
                 continue
             m = self.machine(g)
+            apply_fn = m.apply
+            pg = self._promises.get(g)
             target = int(commit[g])
             before = m.last_applied()
             idx = before + 1
@@ -172,24 +181,25 @@ class ApplyDispatcher:
                     # catch up via recover, not apply.
                     break
                 try:
-                    result = m.apply(idx, payload)
+                    result = apply_fn(idx, payload)
                 except Exception as e:
                     # Retry next round (reference RetryCommandException,
                     # RaftRoutine.java:288-300).  A deterministic failure
                     # freezes the group's apply frontier on purpose —
                     # skipping a committed entry would diverge replicas —
                     # but escalate so the operator sees a stuck group.
-                    n = self._retry_counts[(g, idx)] = \
-                        self._retry_counts.get((g, idx), 0) + 1
+                    n = retries[(g, idx)] = retries.get((g, idx), 0) + 1
                     lvl = log.error if n in (10, 100) or n % 1000 == 0 \
                         else log.warning
                     lvl("apply failed g=%d idx=%d (attempt %d): %s",
                         g, idx, n, e)
                     break
-                self._retry_counts.pop((g, idx), None)
-                fut = self._promises.pop((g, idx), None)
-                if fut is not None and not fut.done():
-                    fut.set_result(result)
+                if retries:
+                    retries.pop((g, idx), None)
+                if pg:
+                    fut = pg.pop(idx, None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(result)
                 idx += 1
             # Mirror tracks true machine progress; on a payload gap or a
             # failed apply it simply stays behind and the lane is revisited

@@ -35,6 +35,7 @@ import logging
 import os
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -44,7 +45,8 @@ import numpy as np
 
 from ..core.step import node_step
 from ..core.types import (
-    I32, LEADER, NIL, EngineConfig, HostInbox, Messages, StepInfo, init_state,
+    I32, I32_SAFE_MAX, LEADER, NIL, EngineConfig, HostInbox, Messages,
+    StepInfo, init_state,
 )
 from ..log.store import LogStore, restore_raft_state
 from ..machine.dispatch import ApplyDispatcher
@@ -123,19 +125,24 @@ class RaftNode:
                  initial_active: Optional[np.ndarray] = None,
                  group_queue_cap: int = 512,
                  total_queue_cap: int = 500_000,
-                 busy_threshold: int = 1_000):
+                 busy_threshold: int = 1_000,
+                 store=None):
         """``transport_factory(node, on_slice, snapshot_provider)`` builds
         the transport endpoint (TcpTransport / LoopbackTransport).
         ``initial_active`` masks which group lanes start open (default all;
         the container passes the admin-group view so closed groups stay
         inert, reference Administrator restart re-creation,
-        command/admin/Administrator.java:50-57)."""
+        command/admin/Administrator.java:50-57).
+        ``store``: any LogStoreSPI product (log/spi.py; reference StateLoader
+        SPI via RaftFactory.loadState, support/RaftFactory.java:18) —
+        default is the durable segmented WAL under ``data_dir``."""
         self.cfg = cfg
         self.node_id = node_id
         self.data_dir = data_dir
         os.makedirs(data_dir, exist_ok=True)
 
-        self.store = LogStore(os.path.join(data_dir, "wal"))
+        self.store = store if store is not None \
+            else LogStore(os.path.join(data_dir, "wal"))
         self.archive = SnapshotArchive(os.path.join(data_dir, "snapshots"))
         self.dispatcher = ApplyDispatcher(
             provider, self._payload,
@@ -184,6 +191,17 @@ class RaftNode:
         # Floors already pushed to the WAL (mirror, avoids per-group floor
         # queries every tick).
         self._wal_floor = self.h_base.astype(np.int64).copy()
+        # Durable-state mirrors for change detection: _persist visits only
+        # groups whose (term, ballot) or durable tail actually moved, so
+        # the steady-state staging cost is O(groups-with-writes), not O(G)
+        # (VERDICT r3 #2 — the per-dirty-group Python loops were the
+        # durable tier's scaling wall).  After restore the device log tail
+        # IS the durable tail, and stable sentinels of -2 force the first
+        # write per lane.
+        self._stable_term_m = np.full(G, -2, np.int64)
+        self._stable_voted_m = np.full(G, -2, np.int64)
+        self._durable_tail_m = np.asarray(self.state.log.last) \
+            .astype(np.int64).copy()
         # Readiness gate (reference Leader.isReady, Leader.java:52-64): a
         # fresh leader reports not-ready until a majority of peers reply.
         self.h_ready = np.zeros(G, bool)
@@ -198,14 +216,28 @@ class RaftNode:
         self.total_queue_cap = total_queue_cap
         self.busy_threshold = busy_threshold   # free slots -> BusyLoopError
 
-        # Snapshot downloads: worker threads ONLY fetch bytes to a temp file;
-        # every store/dispatcher/archive mutation happens on the tick thread
-        # (single-writer discipline — the analog of the reference's
+        # Snapshot downloads: a BOUNDED global worker pool fetches bytes to
+        # temp files (reference: ONE dedicated snapshot NIO thread,
+        # transport/NettyCluster.java:42-43 — thread-per-lagging-group
+        # would spawn thousands under 100k-group catch-up, BASELINE config
+        # 5); every store/dispatcher/archive mutation happens on the tick
+        # thread (single-writer discipline — the analog of the reference's
         # per-group event-loop rule, context/member/RaftMember.java:31-35).
         self._snap_lock = threading.Lock()
+        self._snap_cv = threading.Condition(self._snap_lock)
         self._snap_fetched: List[Tuple[int, int, int, str]] = []
         self._snap_inflight: set = set()
+        # Queue entries carry the lane's fetch epoch: a purge bumps it, so
+        # a stale queued fetch can never run against a recreated lane even
+        # if the lane has re-entered _snap_inflight by the time a worker
+        # pops it (single-flight per group is epoch+membership together).
+        # A deque: mass catch-up (100k lagging groups, BASELINE config 5)
+        # enqueues that many entries, and a list.pop(0) drain would be
+        # O(n^2) under the lock the tick thread shares.
+        self._snap_queue: "deque[Tuple[int, int, int, int, int]]" = deque()
+        self._snap_epoch: Dict[int, int] = {}
         self._snap_threads: List[threading.Thread] = []
+        self.snap_fetch_workers = 4
 
         # Compaction grants computed at the end of tick t, applied in t+1.
         self._compact_grant = np.zeros(G, np.int64)
@@ -219,6 +251,11 @@ class RaftNode:
         self.wal_gc_check_ticks = 128
         self.wal_gc_ratio = 4.0
         self.wal_gc_min_bytes = 8 << 20
+        # _gc_phase handoff protocol: the tick thread writes 0->1 (start),
+        # the worker writes 1->2 or 1->-1 (done/failed), the tick thread
+        # consumes 2/-1 back to 0.  Exactly one side may write in each
+        # phase, and the value is a single int — atomic under CPython's
+        # GIL.  A free-threaded runtime would need a threading.Event here.
         self._gc_phase = 0       # 0 idle / 1 rewriting / 2 finish / -1 abort
         self._gc_thread: Optional[threading.Thread] = None
 
@@ -251,6 +288,8 @@ class RaftNode:
         self.transport.close()
         # In-flight snapshot workers touch the store; they must finish (or
         # observe _stop) before the native WAL handle is released.
+        with self._snap_cv:
+            self._snap_cv.notify_all()
         for t in self._snap_threads:
             t.join(timeout=10)
         # Settle a pending three-phase GC: with the tick thread stopped,
@@ -263,6 +302,7 @@ class RaftNode:
                 # crash-safe; recovery re-derives everything) and bail.
                 log.error("node %d: WAL GC worker failed to stop; leaking "
                           "store handle", self.node_id)
+                self.profiler.close()
                 self.dispatcher.close()
                 return
         if self._gc_phase == 2:
@@ -493,6 +533,20 @@ class RaftNode:
              self.state.role, self.state.leader_id, self.state.commit,
              self.state.log.base, self.state.log.base_term))
 
+        # i32 lane-overflow guard (core/types.py I32_SAFE_MAX): indices,
+        # terms and the tick clock are int32 on device by design — fail
+        # loudly with ~2^20 of headroom rather than wrap silently.  The
+        # long-horizon story is snapshots + lane purge (index resets), not
+        # wider lanes.
+        hi_lane = max(int(np.asarray(h_info.log_tail).max(initial=0)),
+                      int(h_term.max(initial=0)), self.ticks)
+        if hi_lane >= I32_SAFE_MAX:
+            raise OverflowError(
+                f"node {self.node_id}: an int32 engine lane reached "
+                f"{hi_lane} (>= I32_SAFE_MAX {I32_SAFE_MAX}); a group "
+                "needs a snapshot + lane purge before its log index/term "
+                "wraps (see core/types.py)")
+
         old_role = self.h_role
         self.h_role, self.h_leader = h_role, h_leader
         self.h_commit, self.h_base = h_commit, h_base
@@ -543,19 +597,26 @@ class RaftNode:
     def _persist(self, info: StepInfo, h_term, h_voted, h_leader,
                  h_base, h_base_term, staged_payloads, inbox_arrays,
                  submit_n) -> None:
-        dirty = np.nonzero(np.asarray(info.dirty))[0]
+        dirty_mask = np.asarray(info.dirty)
         app_from = np.asarray(info.appended_from)
         app_to = np.asarray(info.appended_to)
-        log_tail = np.asarray(info.log_tail)
+        log_tail = np.asarray(info.log_tail).astype(np.int64)
         sub_start = np.asarray(info.submit_start)
         sub_acc = np.asarray(info.submit_acc)
         any_write = False
 
-        for g in dirty.tolist():
-            # (term, ballot) durable before any reply leaves (reference
-            # RaftMember ctor persists first, context/member/RaftMember.java:25)
+        # (term, ballot) durable before any reply leaves (reference
+        # RaftMember ctor persists first, context/member/RaftMember.java:
+        # 25).  Change-detected in numpy so the Python loop touches only
+        # lanes whose record actually moved (steady state: none).
+        st_changed = dirty_mask & ((h_term != self._stable_term_m)
+                                   | (h_voted != self._stable_voted_m))
+        for g in np.nonzero(st_changed)[0].tolist():
             self.store.put_stable(g, int(h_term[g]), int(h_voted[g]))
             any_write = True
+        if st_changed.any():
+            self._stable_term_m[st_changed] = h_term[st_changed]
+            self._stable_voted_m[st_changed] = h_voted[st_changed]
 
         # Entries appended/overwritten this tick: stage ALL groups' writes
         # into one batch, crossing into the WAL engine once (VERDICT r1 #8
@@ -566,6 +627,15 @@ class RaftNode:
         bat_t: List[int] = []
         bat_p: List[bytes] = []
         commits: List[Tuple[int, int, int]] = []
+        # Own-submission payloads for every accepting group under ONE lock
+        # (was one acquisition per group per tick).
+        own_by_g: Dict[int, List[bytes]] = {}
+        sub_groups = wrote[sub_acc[wrote] > 0]
+        if len(sub_groups):
+            with self._submit_lock:
+                for g in sub_groups.tolist():
+                    q = self._submissions.get(g, [])
+                    own_by_g[g] = [p for p, _ in q[:int(sub_acc[g])]]
         for g in wrote.tolist():
             lo, hi = int(app_from[g]), int(app_to[g])
             n_sub = int(sub_acc[g])
@@ -595,10 +665,15 @@ class RaftNode:
                 bat_t.append(term)
                 bat_p.append(payload)
             if n_sub and not gap and hi >= sub_lo:
-                # own accepted submissions: payloads from the queue (one
-                # lock acquisition for the whole range), all at our term.
+                # own accepted submissions, all at our term.
                 cnt = hi - sub_lo + 1
-                own = self._peek_submissions(g, cnt)
+                own = own_by_g.get(g, [])[:cnt]
+                # The device never accepts more than submit_n (== queue
+                # depth at inbox build); a shorter peek means the durable
+                # log and the promise map would silently desynchronize.
+                assert len(own) == cnt, \
+                    f"g={g}: device accepted {cnt} submissions, queue has " \
+                    f"{len(own)}"
                 bat_g.extend([g] * cnt)
                 bat_i.extend(range(sub_lo, hi + 1))
                 bat_t.extend([int(h_term[g])] * cnt)
@@ -606,13 +681,19 @@ class RaftNode:
             commits.append((g, sub_lo, n_sub))
         if bat_g:
             self.store.append_batch(bat_g, bat_i, bat_t, bat_p)
+            np.maximum.at(self._durable_tail_m,
+                          np.asarray(bat_g, np.int64),
+                          np.asarray(bat_i, np.int64))
             any_write = True
-        for g, sub_lo, n_sub in commits:
-            self._commit_submissions(g, sub_lo, n_sub)
+        self._commit_submissions_batch(commits)
 
         # Truncations: durable tail must not exceed the device tail.
-        for g in dirty.tolist():
+        # Change-detected via the durable-tail mirror (shrinks happen only
+        # on conflict/snapshot discard — rare).
+        shrunk = dirty_mask & (self._durable_tail_m > log_tail)
+        for g in np.nonzero(shrunk)[0].tolist():
             self.store.truncate_to(g, int(log_tail[g]))
+            self._durable_tail_m[g] = log_tail[g]
 
         # WAL floor follows the device compaction floor; the pushed-floor
         # mirror keeps this loop over only the groups that moved.
@@ -620,6 +701,8 @@ class RaftNode:
         for g in np.nonzero(h_base > self._wal_floor)[0].tolist():
             self.store.set_floor(g, int(h_base[g]), int(h_base_term[g]))
             self._wal_floor[g] = h_base[g]
+            if h_base[g] > self._durable_tail_m[g]:
+                self._durable_tail_m[g] = h_base[g]
             wal_floors_moved = True
 
         if any_write or wal_floors_moved:
@@ -635,22 +718,23 @@ class RaftNode:
         for g in rejected.tolist():
             self._reject_submissions(int(g))
 
-    def _peek_submissions(self, g: int, n: int) -> List[bytes]:
+    def _commit_submissions_batch(self, commits) -> None:
+        """Register promises for accepted commands and drop them from their
+        queues — ONE lock acquisition for the whole tick (reference:
+        promise map keyed by EntryKey, context/RaftContext.java:223-237)."""
+        taken_all = []
         with self._submit_lock:
-            return [p for p, _ in self._submissions[g][:n]]
-
-    def _commit_submissions(self, g: int, start_idx: int, n: int) -> None:
-        """Register promises for accepted commands and drop them from the
-        queue (reference: promise map keyed by EntryKey,
-        context/RaftContext.java:223-237)."""
-        if n == 0:
-            return
-        with self._submit_lock:
-            q = self._submissions.get(g, [])
-            taken, self._submissions[g] = q[:n], q[n:]
-            self._queued_total -= len(taken)
-        for k, (_, fut) in enumerate(taken):
-            self.dispatcher.register_promise(g, start_idx + k, fut)
+            for g, start_idx, n in commits:
+                if n == 0:
+                    continue
+                q = self._submissions.get(g, [])
+                taken, self._submissions[g] = q[:n], q[n:]
+                self._queued_total -= len(taken)
+                taken_all.append((g, start_idx, taken))
+        reg = self.dispatcher.register_promise
+        for g, start_idx, taken in taken_all:
+            for k, (_, fut) in enumerate(taken):
+                reg(g, start_idx + k, fut)
 
     def _reject_submissions(self, g: int,
                             exc: Optional[Exception] = None) -> None:
@@ -671,7 +755,12 @@ class RaftNode:
             self.store.reset_group(g)
             self.dispatcher.drop_machine(g, destroy=True)
             self.archive.destroy(g)     # also clears any pending download
-            self._snap_inflight.discard(g)
+            with self._snap_cv:
+                # Epoch bump invalidates any queued-but-unstarted fetch for
+                # the old incarnation even if the recreated lane re-enters
+                # _snap_inflight before the worker pops it.
+                self._snap_epoch[g] = self._snap_epoch.get(g, 0) + 1
+                self._snap_inflight.discard(g)
             self.maintain.note_checkpoint(g, 0, 0)
             self.maintain.snap_index[g] = 0
             self.maintain.applied_at_snap[g] = 0
@@ -695,6 +784,7 @@ class RaftNode:
             match_idx=s.match_idx.at[idx].set(0),
             send_next=s.send_next.at[idx].set(1),
             inflight=s.inflight.at[idx].set(0),
+            hb_inflight=s.hb_inflight.at[idx].set(0),
             sent_at=s.sent_at.at[idx].set(0),
             need_snap=s.need_snap.at[idx].set(False),
             ok_at=s.ok_at.at[idx].set(0),
@@ -710,6 +800,9 @@ class RaftNode:
         hb[np.asarray(lanes)] = 0
         self.h_commit, self.h_base = hc, hb
         self._wal_floor[np.asarray(lanes)] = 0
+        self._durable_tail_m[np.asarray(lanes)] = 0
+        self._stable_term_m[np.asarray(lanes)] = -2
+        self._stable_voted_m[np.asarray(lanes)] = -2
 
     @staticmethod
     def _staged_term(arrays, src: int, g: int, idx: int) -> Optional[int]:
@@ -831,6 +924,7 @@ class RaftNode:
 
     def _snapshot_requests(self, info: StepInfo, h_base) -> None:
         req = np.nonzero(np.asarray(info.snap_req))[0]
+        queued = False
         for g in req.tolist():
             g = int(g)
             if g in self._snap_inflight:
@@ -840,35 +934,74 @@ class RaftNode:
             peer = int(np.asarray(info.snap_req_from)[g])
             if self.archive.pend_snapshot(g, idx, term, peer) is None:
                 continue
-            self._snap_inflight.add(g)
-            t = threading.Thread(
-                target=self._download_snapshot, args=(g, peer, idx, term),
-                name=f"raft-snapfetch-{self.node_id}-g{g}", daemon=True)
-            t.start()
-            self._snap_threads = [x for x in self._snap_threads
-                                  if x.is_alive()]
-            self._snap_threads.append(t)
+            with self._snap_cv:
+                self._snap_inflight.add(g)
+                self._snap_queue.append(
+                    (self._snap_epoch.get(g, 0), g, peer, idx, term))
+                queued = True
+        if queued:
+            with self._snap_cv:
+                self._snap_cv.notify_all()
+            # Lazily grow the pool up to the bound (reference: one snapshot
+            # IO thread; here a small pool, NettyCluster.java:42-43).
+            self._snap_threads = [t for t in self._snap_threads
+                                  if t.is_alive()]
+            while len(self._snap_threads) < self.snap_fetch_workers:
+                t = threading.Thread(
+                    target=self._snap_worker,
+                    name=f"raft-snapfetch-{self.node_id}-"
+                         f"{len(self._snap_threads)}", daemon=True)
+                t.start()
+                self._snap_threads.append(t)
+
+    def _snap_worker(self) -> None:
+        """Pool worker: drain queued snapshot fetches until node shutdown.
+        A fetch queued before a lane purge (stale epoch) or whose lane is
+        no longer marked in flight is skipped."""
+        while True:
+            with self._snap_cv:
+                while not self._snap_queue and not self._stop.is_set():
+                    self._snap_cv.wait(timeout=0.5)
+                if self._stop.is_set():
+                    return
+                ep, g, peer, idx, term = self._snap_queue.popleft()
+                if (ep != self._snap_epoch.get(g, 0)
+                        or g not in self._snap_inflight):
+                    continue
+            self._download_snapshot(g, peer, idx, term, ep)
 
     def _download_snapshot(self, g: int, peer: int, idx: int,
-                           term: int) -> None:
+                           term: int, ep: int) -> None:
         """Worker: fetch ONE snapshot's bytes to a temp file (reference
         SnapChannel download, transport/EventNode.java:122-267).  Install —
         every store/dispatcher/archive mutation — happens on the tick
-        thread in ``_install_snapshots``."""
-        tmp = os.path.join(self.data_dir, f"snap-recv-g{g}.tmp")
+        thread in ``_install_snapshots``.
+
+        ``ep`` is the lane's fetch epoch at dispatch: if a purge bumped it
+        while the fetch was in flight, this download belongs to a dead
+        incarnation — it must neither surface its bytes, nor fail the NEW
+        incarnation's pending, nor cancel its in-flight marker."""
+        tmp = os.path.join(self.data_dir, f"snap-recv-g{g}-e{ep}.tmp")
         ok = False
+
+        def current() -> bool:
+            return ep == self._snap_epoch.get(g, 0)
+
         try:
             res = self.transport.fetch_snapshot(peer, g, idx, term, tmp)
-            if res is None or self._stop.is_set():
-                self.archive.fail_pending(g)
-                return
-            got_idx, got_term = res
-            with self._snap_lock:
+            with self._snap_cv:
+                if res is None or self._stop.is_set() or not current():
+                    if current():
+                        self.archive.fail_pending(g)
+                    return
+                got_idx, got_term = res
                 self._snap_fetched.append((g, got_idx, got_term, tmp))
-            ok = True
+                ok = True
         except Exception:
             log.exception("snapshot fetch failed g=%d", g)
-            self.archive.fail_pending(g)
+            with self._snap_cv:
+                if current():
+                    self.archive.fail_pending(g)
         finally:
             if not ok:
                 # Every failure path drops the partial download.
@@ -876,7 +1009,9 @@ class RaftNode:
                     os.unlink(tmp)
                 except OSError:
                     pass
-            self._snap_inflight.discard(g)
+            with self._snap_cv:
+                if current():
+                    self._snap_inflight.discard(g)
 
     def _install_snapshots(self, fetched) -> List[Tuple[int, int, int]]:
         """Tick thread: install downloaded snapshots (reference
@@ -901,6 +1036,8 @@ class RaftNode:
                 # record rule for snapshots, support/StableLock.java:82-91).
                 self.store.set_floor(g, snap.index, snap.term)
                 self._wal_floor[g] = max(self._wal_floor[g], snap.index)
+                self._durable_tail_m[g] = max(self._durable_tail_m[g],
+                                              snap.index)
                 self.store.sync()
                 self.maintain.note_checkpoint(g, self.ticks, snap.index)
                 self.metrics["snapshots_installed"] += 1

@@ -42,13 +42,16 @@ class LocalCluster:
     def __init__(self, cfg: EngineConfig, root: str,
                  provider_factory: Optional[Callable[[int], object]] = None,
                  seed: int = 0,
-                 maintain_factory: Optional[Callable[[], object]] = None):
+                 maintain_factory: Optional[Callable[[], object]] = None,
+                 store_factory: Optional[Callable[[int], object]] = None):
         """``provider_factory(node_id)`` returns a MachineProvider; defaults
         to FileMachine per group under ``root/node<i>/machines`` (the
         reference's file-append oracle, cluster/cmd/FileMachine.java).
         ``maintain_factory()`` builds a per-node MaintainAgreement (e.g. the
         reference test configs' aggressive all-thresholds-1 snapshot cadence,
-        test/resources/raft1.xml:22-28)."""
+        test/resources/raft1.xml:22-28).
+        ``store_factory(node_id)`` builds a LogStoreSPI product per node
+        (log/spi.py; default: the durable WAL under the node's data dir)."""
         self.cfg = cfg
         self.root = root
         self.seed = seed
@@ -57,6 +60,7 @@ class LocalCluster:
             lambda i: FileMachineProvider(
                 os.path.join(root, f"node{i}", "machines")))
         self.maintain_factory = maintain_factory
+        self.store_factory = store_factory
         self.nodes: Dict[int, RaftNode] = {}
         for i in range(cfg.n_peers):
             self.start_node(i)
@@ -77,7 +81,8 @@ class LocalCluster:
             self.cfg, i, os.path.join(self.root, f"node{i}"),
             self.provider_factory(i), self._factory(i), seed=self.seed,
             maintain=(self.maintain_factory()
-                      if self.maintain_factory else None))
+                      if self.maintain_factory else None),
+            store=(self.store_factory(i) if self.store_factory else None))
         node.transport.start()
         self.nodes[i] = node
         return node

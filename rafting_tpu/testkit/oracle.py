@@ -99,6 +99,7 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     match_idx = s["match_idx"].copy()
     send_next = s["send_next"].copy()
     inflight = s["inflight"].copy()
+    hb_inflight = s["hb_inflight"].copy()
     sent_at = s["sent_at"].copy()
     need_snap = s["need_snap"].copy()
     ok_at = s["ok_at"].copy()
@@ -126,13 +127,15 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         "ae_ents": zi(P, G, B),
         "aer_valid": zb(P, G), "aer_term": zi(P, G),
         "aer_success": zb(P, G), "aer_match": zi(P, G),
+        "aer_empty": zb(P, G),
         "rv_valid": zb(P, G), "rv_term": zi(P, G), "rv_last_idx": zi(P, G),
         "rv_last_term": zi(P, G), "rv_prevote": zb(P, G),
         "rvr_valid": zb(P, G), "rvr_term": zi(P, G), "rvr_granted": zb(P, G),
         "rvr_prevote": zb(P, G), "rvr_echo": zi(P, G),
         "is_valid": zb(P, G), "is_term": zi(P, G), "is_idx": zi(P, G),
-        "is_last_term": zi(P, G),
+        "is_last_term": zi(P, G), "is_probe": zb(P, G),
         "isr_valid": zb(P, G), "isr_term": zi(P, G), "isr_success": zb(P, G),
+        "isr_probe": zb(P, G),
     }
     info = {
         "submit_start": zi(G), "submit_acc": zi(G), "dirty": zb(G),
@@ -239,6 +242,7 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
             match_idx[g] = 0
             send_next[g] = log.last + 1
             inflight[g] = 0
+            hb_inflight[g] = 0
             need_snap[g] = False
             ok_at[g] = 0
             fail_at[g] = 0
@@ -295,6 +299,9 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
                 out["aer_match"][p, g] = (
                     tail if (sel and acc)
                     else min(log.last, int(ib["ae_prev_idx"][p, g]) - 1))
+                # Heartbeat echo: the sender never charged an empty AE
+                # against its window, so the reply must not decrement it.
+                out["aer_empty"][p, g] = int(ib["ae_n"][p, g]) == 0
 
         # ---- 5. InstallSnapshot -------------------------------------------
         # (reference Follower.installSnapshot:130-153 + host completion,
@@ -327,6 +334,7 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
                 out["isr_term"][p, g] = term[g]
                 out["isr_success"][p, g] = (is_ok[p] and p == is_peer
                                             and covered)
+                out["isr_probe"][p, g] = bool(ib["is_probe"][p, g])
 
         if (h["snap_done"][g] and active[g]
                 and int(h["snap_idx"][g]) > log.base):
@@ -361,9 +369,15 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
             # Unconditional floor (kernel applies it to every lane).
             next_idx[g, p] = max(next_idx[g, p], log.base + 1)
             if r:
-                inflight[g, p] = max(inflight[g, p] - 1, 0)
+                # Heartbeat replies (aer_empty) release a heartbeat slot;
+                # data replies release a data slot (lanes never cross).
+                if ib["aer_empty"][p, g]:
+                    hb_inflight[g, p] = max(hb_inflight[g, p] - 1, 0)
+                else:
+                    inflight[g, p] = max(inflight[g, p] - 1, 0)
                 if not ib["aer_success"][p, g]:
                     inflight[g, p] = 0
+                    hb_inflight[g, p] = 0
                     send_next[g, p] = next_idx[g, p]
                 ok_at[g, p] = now
                 fail_streak[g, p] = 0
@@ -374,7 +388,9 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
                     need_snap[g, p] = False
                     next_idx[g, p] = max(next_idx[g, p], log.base + 1)
                     match_idx[g, p] = max(match_idx[g, p], log.base)
-                inflight[g, p] = max(inflight[g, p] - 1, 0)
+                # Probe re-offers never occupied a slot (isr_probe echo).
+                if not ib["isr_probe"][p, g]:
+                    inflight[g, p] = max(inflight[g, p] - 1, 0)
                 ok_at[g, p] = now
                 fail_streak[g, p] = 0
             # The pipeline head never trails the ack base.
@@ -433,21 +449,31 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
             for p in range(P):
                 if p == me:
                     continue
-                # RPC timeout: reset the window, record failure evidence
-                # (reference statFailure, Leadership.java:65-73).
-                if (inflight[g, p] > 0
-                        and now - sent_at[g, p] >= cfg.rpc_timeout_ticks):
+                # RPC timeout — the only failure evidence, anchored to our
+                # own last occupying send (see kernel phase 9; reference
+                # statFailure, Leadership.java:65-73).
+                timed_out = (inflight[g, p] + hb_inflight[g, p] > 0
+                             and now - sent_at[g, p] >= cfg.rpc_timeout_ticks)
+                if timed_out:
                     fail_streak[g, p] += 1
                     fail_at[g, p] = now
                     send_next[g, p] = next_idx[g, p]
                     inflight[g, p] = 0
+                    hb_inflight[g, p] = 0
                 has_data = (log.last >= send_next[g, p]
                             and not need_snap[g, p])
-                can_send = inflight[g, p] < cfg.inflight_limit
+                can_send = (inflight[g, p] + hb_inflight[g, p]
+                            < cfg.inflight_limit)
                 send_data = not need_snap[g, p] and has_data and can_send
+                # Heartbeats flow on the cadence regardless of window state
+                # (slot-exempt when full; reference heartbeat budget
+                # division, Leader.java:162).
                 send_hb = (not need_snap[g, p] and heartbeat
-                           and not has_data and can_send)
-                send_is = need_snap[g, p] and inflight[g, p] == 0
+                           and not send_data)
+                hb_occupy = send_hb and can_send
+                send_is_win = (need_snap[g, p]
+                               and inflight[g, p] + hb_inflight[g, p] == 0)
+                send_is = send_is_win or (need_snap[g, p] and heartbeat)
                 if send_data or send_hb:
                     n_send = (min(B, log.last - send_next[g, p] + 1)
                               if send_data else 0)
@@ -473,8 +499,15 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
                     out["is_term"][p, g] = term[g]
                     out["is_idx"][p, g] = log.base
                     out["is_last_term"][p, g] = log.base_term
-                if send_data or send_hb or send_is:
+                    out["is_probe"][p, g] = not send_is_win
+                # Data batches and first snapshot offers occupy data
+                # slots, in-window heartbeats occupy heartbeat slots; any
+                # occupying send refreshes the send clock.
+                if send_data or send_is_win:
                     inflight[g, p] += 1
+                if hb_occupy:
+                    hb_inflight[g, p] += 1
+                if send_data or send_is_win or hb_occupy:
                     sent_at[g, p] = now
         if heartbeat:
             hb_due[g] = now + cfg.heartbeat_ticks
@@ -540,6 +573,7 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         "log.last": last,
         "next_idx": next_idx, "match_idx": match_idx,
         "send_next": send_next, "inflight": inflight,
+        "hb_inflight": hb_inflight,
         "sent_at": sent_at, "need_snap": need_snap,
         "ok_at": ok_at, "fail_at": fail_at, "fail_streak": fail_streak,
         "votes": votes, "prevotes": prevotes,

@@ -51,13 +51,14 @@ _HDR = struct.Struct("<IBII")
 KIND_FIELDS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "ae": ("ae_valid", ("ae_term", "ae_prev_idx", "ae_prev_term",
                         "ae_commit", "ae_n", "ae_ents")),
-    "aer": ("aer_valid", ("aer_term", "aer_success", "aer_match")),
+    "aer": ("aer_valid", ("aer_term", "aer_success", "aer_match",
+                          "aer_empty")),
     "rv": ("rv_valid", ("rv_term", "rv_last_idx", "rv_last_term",
                         "rv_prevote")),
     "rvr": ("rvr_valid", ("rvr_term", "rvr_granted", "rvr_prevote",
                           "rvr_echo")),
-    "is": ("is_valid", ("is_term", "is_idx", "is_last_term")),
-    "isr": ("isr_valid", ("isr_term", "isr_success")),
+    "is": ("is_valid", ("is_term", "is_idx", "is_last_term", "is_probe")),
+    "isr": ("isr_valid", ("isr_term", "isr_success", "isr_probe")),
 }
 KIND_IDS = {k: i for i, k in enumerate(KIND_FIELDS)}
 KIND_BY_ID = {i: k for k, i in KIND_IDS.items()}
@@ -95,12 +96,30 @@ class FrameReader:
         return out
 
 
+def _schema_tag() -> int:
+    """CRC of the per-kind field tables: two peers agree on the MSGS wire
+    layout iff their tags match.  Carried in HELLO so a field-list change
+    (e.g. aer_empty / is_probe) rejects a mixed-version peer with ONE
+    clear log line instead of presenting as endless opaque connection
+    drops when the misaligned columns fail the body bounds checks."""
+    desc = ";".join(f"{k}:{v}:{','.join(d)}"
+                    for k, (v, d) in KIND_FIELDS.items())
+    return zlib.crc32(desc.encode())
+
+
+SCHEMA_TAG = _schema_tag()
+
+
 def pack_hello(node_id: int, G: int, P: int, B: int) -> bytes:
-    return frame(HELLO, struct.pack("<IIII", node_id, G, P, B))
+    return frame(HELLO, struct.pack("<IIIII", node_id, G, P, B, SCHEMA_TAG))
 
 
-def unpack_hello(body: bytes) -> Tuple[int, int, int, int]:
-    return struct.unpack("<IIII", body)
+def unpack_hello(body: bytes) -> Tuple[int, int, int, int, int]:
+    """Returns (node_id, G, P, B, schema_tag); a legacy 16-byte HELLO
+    (no tag) yields tag 0, which never matches a real CRC."""
+    if len(body) == 16:
+        return struct.unpack("<IIII", body) + (0,)
+    return struct.unpack("<IIIII", body)
 
 
 def pack_snap_req(group: int, index: int, term: int) -> bytes:
@@ -202,6 +221,10 @@ def pack_slice(src: int, fields: Dict[str, np.ndarray],
             # indistinguishable from network loss, which the engine's
             # resend/timeout path already recovers.  Shipping a substitute
             # empty command would silently diverge replica state.
+            # Blob layout: one u32 length VECTOR for all kept entries, then
+            # the payload bytes concatenated — two bulk ops instead of a
+            # struct.pack per entry (the pack path is on the per-tick
+            # critical section of every node).
             prevs = fields["ae_prev_idx"][cols]
             ns = fields["ae_n"][cols]
             keep, blobs = [], []
@@ -210,9 +233,10 @@ def pack_slice(src: int, fields: Dict[str, np.ndarray],
                 if any(p is None for p in win):
                     continue
                 keep.append(g)
-                blobs.extend(struct.pack("<I", len(p)) + p for p in win)
+                blobs.extend(win)
             cols = np.asarray(keep, np.uint32)
-            blob_section = b"".join(blobs)
+            lens = np.fromiter(map(len, blobs), np.uint32, len(blobs))
+            blob_section = lens.tobytes() + b"".join(blobs)
         n_total += len(cols)
         parts.append(struct.pack("<BI", KIND_IDS[kind], len(cols)))
         if len(cols) == 0:
@@ -280,15 +304,22 @@ def unpack_slice(body: bytes, template: Dict[str, Tuple[np.dtype, tuple]],
             out[f] = (cols, vals)
         if kind == "ae":
             prevs = out["ae_prev_idx"][1]
-            ns = out["ae_n"][1]
+            ns = out["ae_n"][1].astype(np.int64)
+            total = int(ns.sum())
+            need(4 * total, off)
+            lens = np.frombuffer(body, np.uint32, total, off)
+            off += 4 * total
+            ends = np.cumsum(lens, dtype=np.int64)
+            need(int(ends[-1]) if total else 0, off)
+            starts = ends - lens
+            k = 0
             for g, prev, n in zip(cols.tolist(), prevs.tolist(), ns.tolist()):
-                for idx in range(int(prev) + 1, int(prev) + 1 + int(n)):
-                    need(4, off)
-                    (plen,) = struct.unpack_from("<I", body, off)
-                    off += 4
-                    need(plen, off)
-                    payloads[(int(g), idx)] = body[off:off + plen]
-                    off += plen
+                g, base = int(g), int(prev) + 1
+                for j in range(int(n)):
+                    payloads[(g, base + j)] = \
+                        body[off + starts[k]:off + ends[k]]
+                    k += 1
+            off += int(ends[-1]) if total else 0
     return src, out, payloads
 
 

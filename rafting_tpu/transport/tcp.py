@@ -252,10 +252,16 @@ class TcpTransport:
                     return
                 for ftype, body in reader.feed(data):
                     if ftype == codec.HELLO:
-                        nid, G, P, B = codec.unpack_hello(body)
+                        nid, G, P, B, tag = codec.unpack_hello(body)
                         if (G, P, B) != (self.cfg.n_groups, self.cfg.n_peers,
                                          self.cfg.batch):
                             log.error("shape mismatch from node %d", nid)
+                            return
+                        if tag != codec.SCHEMA_TAG:
+                            log.error("wire-schema mismatch from node %d "
+                                      "(tag %#x != ours %#x) — peer runs a "
+                                      "different build", nid, tag,
+                                      codec.SCHEMA_TAG)
                             return
                         src = nid
                     elif ftype == codec.MSGS:

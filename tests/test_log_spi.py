@@ -1,0 +1,90 @@
+"""Pluggable log-store SPI (VERDICT r3 #6; reference StateLoader SPI,
+command/spi/StateLoader.java:8-12, swapped via RaftFactory.loadState,
+support/RaftFactory.java:18).
+
+Covers: protocol conformance of both in-tree stores, a full 3-node cluster
+running on MemoryLogStore (committing without ever touching a WAL dir),
+and the factory hook wiring the store into the node."""
+
+import os
+
+import pytest
+
+from rafting_tpu.core.types import EngineConfig
+from rafting_tpu.log import LogStore, LogStoreSPI, MemoryLogStore
+from rafting_tpu.testkit.harness import LocalCluster
+
+CFG = EngineConfig(n_groups=4, n_peers=3, log_slots=32, batch=4,
+                   max_submit=4, election_ticks=10, heartbeat_ticks=3,
+                   rpc_timeout_ticks=5)
+
+
+def test_protocol_conformance(tmp_path):
+    mem = MemoryLogStore()
+    assert isinstance(mem, LogStoreSPI)
+    wal = LogStore(str(tmp_path / "wal"))
+    try:
+        assert isinstance(wal, LogStoreSPI)
+    finally:
+        wal.close()
+
+
+def test_memstore_roundtrip_and_export():
+    s = MemoryLogStore()
+    s.put_stable(0, term=3, ballot=1)
+    s.append_batch([0, 0, 1], [1, 2, 1], [3, 3, 2], [b"a", b"b", b"c"])
+    s.sync()
+    assert s.tail(0) == 2 and s.tail(1) == 1
+    assert s.payload(0, 2) == b"b"
+    assert s.entry_term(1, 1) == 2
+    assert s.payloads_window(0, 1, 3) == [b"a", b"b", None]
+    s.truncate_to(0, 1)
+    assert s.tail(0) == 1 and s.payload(0, 2) is None
+    s.set_floor(1, 1, 2)
+    assert s.floor(1) == 1 and s.floor_term(1) == 2
+    assert s.payload(1, 1) is None  # pruned below floor
+    ex = s.export_state(4, 32)
+    assert ex["has_stable"][0] == 1 and ex["stable_term"][0] == 3
+    assert ex["tail"][0] == 1 and ex["live_count"][0] == 1
+    assert ex["ring"][0, 1] == 3
+    assert ex["floor"][1] == 1
+    s.reset_group(0)
+    assert s.tail(0) == 0 and s.stable(0) is None
+
+
+def test_cluster_runs_on_memory_store(tmp_path):
+    """A whole 3-node cluster over MemoryLogStore: commands commit and
+    apply, and no node ever creates a WAL directory."""
+    c = LocalCluster(CFG, str(tmp_path),
+                     store_factory=lambda i: MemoryLogStore())
+    try:
+        res = c.submit_via_leader(0, b"hello-spi")
+        assert res is not None
+        c.assert_file_parity(0)
+        for i in range(3):
+            assert not os.path.exists(
+                os.path.join(str(tmp_path), f"node{i}", "wal")), \
+                "memory store must not touch disk"
+            assert isinstance(c.nodes[i].store, MemoryLogStore)
+    finally:
+        c.close()
+
+
+def test_factory_log_store_hook(tmp_path):
+    """RaftFactory.log_store product reaches the node (reference
+    RaftFactory.loadState wiring, RaftContainer.java:41-58)."""
+    from rafting_tpu.api.config import RaftConfig
+    from rafting_tpu.api.factory import RaftFactory
+
+    class MemFactory(RaftFactory):
+        def log_store(self, config, node_id):
+            return MemoryLogStore()
+
+    cfg = RaftConfig(local="raft://127.0.0.1:7101",
+                     peers=("raft://127.0.0.1:7102", "raft://127.0.0.1:7103"),
+                     data_dir=str(tmp_path / "n0"), n_groups=2)
+    node = MemFactory().build_node(cfg)
+    try:
+        assert isinstance(node.store, MemoryLogStore)
+    finally:
+        node.close()

@@ -92,7 +92,8 @@ def test_frame_reader_partial_and_crc():
     assert r.feed(body[:5]) == []
     frames = r.feed(body[5:])
     assert frames[0][0] == codec.HELLO
-    assert codec.unpack_hello(frames[0][1]) == (1, 8, 3, 4)
+    assert codec.unpack_hello(frames[0][1]) == (1, 8, 3, 4,
+                                                codec.SCHEMA_TAG)
     bad = bytearray(body)
     bad[-1] ^= 0xFF
     with pytest.raises(IOError):

@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Bisect the >=32k-group TPU fault (VERDICT r3 weak #1).
+
+Runs ONE configuration in-process (invoke per-config in a subprocess; a
+kernel fault kills the child, not the sweep):
+
+    python tools/bisect_tpu.py <n_groups> <group_block> <donate:0|1> \
+        [n_calls] [ticks]
+
+Prints one JSON line with the outcome.  The r3 ladder showed warmup (first
+call) SUCCEEDS at 65k and the measure (second, donated-buffer) call faults
+UNAVAILABLE — so the sweep separates (a) program size per block, (b) buffer
+donation, (c) call count.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    n_groups = int(sys.argv[1])
+    block = int(sys.argv[2])
+    donate = bool(int(sys.argv[3]))
+    n_calls = int(sys.argv[4]) if len(sys.argv) > 4 else 2
+    ticks = int(sys.argv[5]) if len(sys.argv) > 5 else 128
+
+    import faulthandler
+    faulthandler.enable()
+    faulthandler.dump_traceback_later(300, exit=False)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from functools import partial
+    from rafting_tpu import DeviceCluster, EngineConfig
+    from rafting_tpu.core import sim
+
+    dev = jax.devices()[0]
+    cfg = EngineConfig(n_groups=n_groups, n_peers=3, log_slots=64, batch=8,
+                       max_submit=8, election_ticks=10, heartbeat_ticks=3,
+                       rpc_timeout_ticks=8, pre_vote=True)
+
+    if donate:
+        fn = (partial(sim.run_cluster_ticks_blocked, group_block=block)
+              if block < n_groups else sim.run_cluster_ticks)
+    else:
+        # Re-jit the underlying functions WITHOUT donate_argnums.
+        if block < n_groups:
+            raw = partial(jax.jit, static_argnums=(0, 1, 7))(
+                sim.run_cluster_ticks_blocked.__wrapped__)
+            fn = partial(raw, group_block=block)
+        else:
+            fn = partial(jax.jit, static_argnums=(0, 1))(
+                sim.run_cluster_ticks.__wrapped__)
+
+    c = DeviceCluster(cfg, seed=0)
+    submit = jnp.full((3, n_groups), cfg.max_submit, jnp.int32)
+    states, inflight, info = c.states, c.inflight, c.last_info
+    out = {"n_groups": n_groups, "block": block, "donate": donate,
+           "platform": dev.platform, "calls": []}
+    for k in range(n_calls):
+        t0 = time.perf_counter()
+        states, inflight, info = fn(cfg, ticks, states, inflight, info,
+                                    c.conn, submit)
+        jax.block_until_ready(states.commit)
+        out["calls"].append(round(time.perf_counter() - t0, 2))
+    out["commits"] = int(np.asarray(states.commit).max(axis=0)
+                         .astype(np.int64).sum())
+    out["ok"] = True
+    faulthandler.cancel_dump_traceback_later()
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
