@@ -175,11 +175,13 @@ def child_run(n_groups: int, measure_ticks: int, warmup_ticks: int,
     }
 
 
-def headline(res: dict, fallback: bool = False, tuned: bool = False) -> dict:
+def headline(res: dict, fallback: bool = False, tuned: bool = False,
+             extra_note: str = "") -> dict:
     plat = res["platform"]
     tag = "" if plat == "cpu" else " on device"
     note = " [CPU FALLBACK — device unreachable]" if fallback else ""
     note += TUNED_TAG if tuned else ""
+    note += f" [{extra_note}]" if extra_note else ""
     return {
         "metric": f"AppendEntries commits/sec @{res['scale'] // 1000}k Raft "
                   f"groups (3-node cluster, full consensus loop{tag}){note}",
@@ -253,7 +255,9 @@ def main() -> None:
     scale_timeout = float(os.environ.get("BENCH_SCALE_TIMEOUT", "300"))
     # Global wall budget: keep the whole ladder inside the driver's window
     # even if several scales burn their full timeout.
-    budget = float(os.environ.get("BENCH_TOTAL_BUDGET", "1500"))
+    # The healthy-TPU ladder measures ~1300 s end to end (r4); leave room
+    # for the tuned bonus stage on top.
+    budget = float(os.environ.get("BENCH_TOTAL_BUDGET", "2200"))
     t_start = time.monotonic()
 
     best = None
@@ -305,22 +309,42 @@ def main() -> None:
               "value": 0, "unit": "commits/sec", "vs_baseline": 0.0})
         sys.exit(1)
 
-    # Bonus stage: the conservative number is banked; if the top scale
-    # passed (device OR a healthy CPU-only ladder), try once more with the
-    # tuned pipeline budget (2x+ on CPU) and publish whichever is better,
-    # tagged so the artifact records which config produced it.
-    remaining = budget - (time.monotonic() - t_start)
-    if (best["scale"] == scales[-1] and only is None and not best_is_tuned
-            and remaining > scale_timeout * 0.5
-            and not any(k in os.environ for k in TUNED_ENV)):
-        ticks, warmup = (512, 128) if best["platform"] != "cpu" else (96, 48)
+    # Bonus stages: the conservative number is banked; if the top scale
+    # passed, try better configurations and publish whichever wins, tagged
+    # so the artifact records which config produced it.
+    #
+    # 1. Pallas quorum kernel — same per-tick cost as the main ladder
+    #    (fits the normal scale timeout) and measured +6% over inline jnp
+    #    at 16k on TPU (r4 A/B).  Device only: on CPU the kernel runs
+    #    interpret-mode at 1000x cost.
+    # 2. Tuned pipeline budget (S=32/B=32/L=256) — 2x+ on CPU; slower per
+    #    tick on device at the top scale, so it gets halved tick counts
+    #    and a longer deadline (the r4 tuned stage at 100k timed out at
+    #    512 ticks / 300 s).
+    def bonus(extra_env, tag, ticks, warmup, timeout_s):
+        nonlocal best
+        remaining = budget - (time.monotonic() - t_start)
+        if remaining < timeout_s * 0.4:
+            return
         res = run_scale(best["scale"], ticks, warmup,
-                        min(scale_timeout, remaining),
-                        profile_dir=profile_dir, extra_env=TUNED_ENV)
+                        min(timeout_s, remaining),
+                        profile_dir=profile_dir, extra_env=extra_env)
         if res is not None and res["cps"] > best["cps"]:
-            sys.stderr.write(f"[bench] tuned budget: {res['cps']:,.0f} "
-                             "commits/s\n")
-            emit(headline(res, tuned=True))
+            sys.stderr.write(f"[bench] {tag}: {res['cps']:,.0f} commits/s\n")
+            emit(headline(res, tuned=(extra_env is TUNED_ENV),
+                          extra_note="" if extra_env is TUNED_ENV else tag))
+            best = res
+
+    if best["scale"] == scales[-1] and only is None and not best_is_tuned:
+        bonus_timeout = float(os.environ.get("BENCH_BONUS_TIMEOUT", "420"))
+        if (best["platform"] != "cpu"
+                and "BENCH_USE_PALLAS" not in os.environ):
+            bonus({"BENCH_USE_PALLAS": "1"}, "pallas quorum kernel",
+                  512, 128, scale_timeout)
+        if not any(k in os.environ for k in TUNED_ENV):
+            ticks, warmup = (256, 64) if best["platform"] != "cpu" \
+                else (96, 48)
+            bonus(TUNED_ENV, "tuned budget", ticks, warmup, bonus_timeout)
 
 
 if __name__ == "__main__":

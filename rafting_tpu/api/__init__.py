@@ -9,11 +9,13 @@ from .anomaly import (
 from .config import RaftConfig, load_xml_config
 from .container import ADMIN_GROUP, GroupRegistry, RaftContainer
 from .factory import RaftFactory
+from .serial import CmdSerializer, JsonSerializer, RawSerializer
 from .stub import RaftStub
 
 __all__ = [
     "RaftConfig", "load_xml_config", "RaftContainer", "RaftFactory",
     "RaftStub", "GroupRegistry", "ADMIN_GROUP",
+    "CmdSerializer", "JsonSerializer", "RawSerializer",
     "RaftError", "NotLeaderError", "NotReadyError", "BusyLoopError",
     "ObsoleteContextError", "WaitTimeoutError", "RetryCommandError",
     "SerializeError",

@@ -40,13 +40,20 @@ class RaftFactory:
         the default WAL under its data dir."""
         return None
 
+    def serializer(self, config: RaftConfig):
+        """Build the command/result serializer (api/serial.py; reference
+        CmdSerializer SPI, support/serial/CmdSerializer.java:11-24).
+        Return None for the JSON default."""
+        return None
+
     def transport_factory(self, config: RaftConfig) -> Callable:
         peers = dict(enumerate(config.node_addresses()))
 
         def build(node, on_slice, snapshot_provider):
             return TcpTransport(node.node_id, peers, node.cfg,
                                 node.template, on_slice, snapshot_provider,
-                                submit_handler=node.submit)
+                                submit_handler=node.submit,
+                                result_encoder=node.serializer.encode_result)
         return build
 
     def maintain(self, config: RaftConfig):
@@ -68,4 +75,5 @@ class RaftFactory:
             total_queue_cap=config.total_queue_cap,
             busy_threshold=config.busy_threshold,
             store=self.log_store(config, node_id),
+            serializer=self.serializer(config),
         )

@@ -7,7 +7,6 @@ command/RaftStub.java:47-110, RaftContainer.getStub:92-111)."""
 
 from __future__ import annotations
 
-import json
 import threading
 from concurrent.futures import Future, TimeoutError as _FutTimeout
 from typing import Any, Optional, Union
@@ -17,17 +16,16 @@ from .anomaly import (
 )
 
 
-def _encode(command: Union[bytes, str]) -> bytes:
-    return command.encode("utf-8") if isinstance(command, str) else command
-
-
 class RaftStub:
     def __init__(self, container, name: str, lane: int, forward: bool = True):
         """``forward=True`` relays submissions to the current leader over
         the transport when this node is a follower, instead of bouncing
         NotLeader back to the caller (the reference only returns the hint,
-        support/anomaly/NotLeaderException.java:11-27; forwarded results
-        must be JSON-serializable)."""
+        support/anomaly/NotLeaderException.java:11-27).  Commands and
+        forwarded results travel through the node's CmdSerializer
+        (api/serial.py; JSON by default — plug RawSerializer or your own
+        for arbitrary result types, the reference CmdSerializer contract,
+        support/serial/CmdSerializer.java:11-24)."""
         self._container = container
         self.name = name
         self._lane = lane
@@ -57,8 +55,8 @@ class RaftStub:
         local log are forwarded."""
         if self._closed:
             raise ObsoleteContextError(f"stub for {self.name!r} closed")
-        payload = _encode(command)
         node = self._container._node
+        payload = node.serializer.encode_command(command)
         if node.is_leader(self.lane) or not self.forward:
             fut = node.submit(self.lane, payload)
             # A synchronous fast-fail (leadership moved between our check
@@ -100,7 +98,7 @@ class RaftStub:
                 if not ok:
                     raise RaftError(
                         f"forward failed: {raw.decode(errors='replace')}")
-                out.set_result(json.loads(raw))
+                out.set_result(node.serializer.decode_result(raw))
             except Exception as e:
                 if not out.done():
                     out.set_exception(e)

@@ -159,7 +159,29 @@ class DeviceCluster:
         self.states, self.inflight, info = cluster_step(
             self.cfg, self.states, self.inflight, host, self.conn)
         self.last_info = info
+        if self.cfg.debug_checks:
+            self._debug_check(info)
         return info
+
+    def _debug_check(self, info: StepInfo) -> None:
+        """cfg.debug_checks: surface in-kernel violations (per-node lanes)
+        plus the one cross-node invariant a single node cannot see —
+        at most one leader per (group, term), the election-safety assert
+        of the reference (Follower.java:48-50, Leader.java:79-81)."""
+        from .step import raise_debug_violations
+        raise_debug_violations(info, "cluster tick")
+        role = np.asarray(self.states.role)
+        term = np.asarray(self.states.term)
+        N = role.shape[0]
+        for i in range(N):
+            for j in range(i + 1, N):
+                both = ((role[i] == LEADER) & (role[j] == LEADER)
+                        & (term[i] == term[j]))
+                if both.any():
+                    g = int(np.nonzero(both)[0][0])
+                    raise AssertionError(
+                        f"election safety violated: nodes {i} and {j} both "
+                        f"lead group {g} at term {int(term[i, g])}")
 
     def run(self, n_ticks: int, submit_n=None) -> None:
         for _ in range(n_ticks):

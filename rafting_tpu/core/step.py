@@ -53,6 +53,36 @@ from .types import (
 
 Array = jax.Array
 
+# StepInfo.debug_viol codes (cfg.debug_checks; see the check block at the
+# end of node_step).
+DEBUG_CODES = {
+    1: "live log window exceeds ring capacity",
+    2: "commit passed the log end",
+    3: "term regressed",
+    4: "continuing leader's matchIndex moved backwards",
+    5: "candidate ballot is not itself",
+    6: "commit regressed",
+    7: "pipeline head behind ack base",
+}
+
+
+def raise_debug_violations(info, where: str = "") -> None:
+    """Host-side consumer of StepInfo.debug_viol: raise naming the group
+    and the violated invariant (the assert analog of the reference's
+    AssertionError surfacing, pinned to the faulting phase)."""
+    import numpy as np
+
+    viol = np.asarray(info.debug_viol)
+    bad = np.nonzero(viol)
+    if len(bad[0]):
+        first = tuple(int(i) for i in (b[0] for b in bad))
+        code = int(viol[first])
+        raise AssertionError(
+            f"kernel invariant violated{' in ' + where if where else ''}: "
+            f"lane {first} code {code} "
+            f"({DEBUG_CODES.get(code, 'unknown')}); "
+            f"{len(bad[0])} lane(s) total")
+
 
 # ---------------------------------------------------------------------------
 # Log-ring primitives.  The log is a per-group ring of entry terms: index i
@@ -616,6 +646,36 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     dirty = (term != old_term) | (voted != old_voted) | (log.last != old_last) \
         | (app_to > 0)
 
+    # In-kernel invariant checks (cfg.debug_checks; zero cost when off —
+    # the branch is resolved at trace time).  The vectorized analog of the
+    # reference's hot-path AssertionErrors (ring/log continuity
+    # RocksLog.java:175-187, monotonic matchIndex Leadership.java:76-81,
+    # role/ballot sanity Follower.java:48-50): a violation pinpoints the
+    # faulting phase by code instead of surfacing as downstream
+    # divergence.  Codes in DEBUG_CODES; the host raises on any nonzero.
+    debug_viol = jnp.zeros((G,), I32)
+    if cfg.debug_checks:
+        def flag(viol, cond, code):
+            return jnp.where(active & cond & (viol == 0),
+                             jnp.asarray(code, I32), viol)
+        # 1: live window exceeds ring capacity (entries would alias).
+        debug_viol = flag(debug_viol, log.last - log.base > L, 1)
+        # 2: commit passed the log end.
+        debug_viol = flag(debug_viol, commit > jnp.maximum(log.last, log.base), 2)
+        # 3: term regressed within one step.
+        debug_viol = flag(debug_viol, term < s.term, 3)
+        # 4: a continuing leader's matchIndex moved backwards.
+        debug_viol = flag(
+            debug_viol,
+            (s.role == LEADER) & (role == LEADER)
+            & (match_idx < s.match_idx).any(axis=1), 4)
+        # 5: candidate whose ballot is not itself.
+        debug_viol = flag(debug_viol, (role == CANDIDATE) & (voted != me), 5)
+        # 6: commit regressed.
+        debug_viol = flag(debug_viol, commit < s.commit, 6)
+        # 7: pipeline head behind the ack base.
+        debug_viol = flag(debug_viol, (send_next < next_idx).any(axis=1), 7)
+
     new_state = RaftState(
         node_id=s.node_id, now=now, rng=rng, active=active,
         term=term, role=role, voted_for=voted, leader_id=leader_id,
@@ -650,6 +710,6 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         appended_from=app_from, appended_to=app_to, log_tail=log.last,
         commit=commit, leader=leader_id, ready=ready, snap_req=snap_req,
         snap_req_from=snap_from, snap_req_idx=snap_idx_o,
-        snap_req_term=snap_term_o,
+        snap_req_term=snap_term_o, debug_viol=debug_viol,
     )
     return new_state, outbox, info

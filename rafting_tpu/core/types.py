@@ -88,6 +88,13 @@ class EngineConfig:
     recovery_ticks: int = 6       # peer stays unhealthy until this long after its
                                   #     last failure (reference recoveryCoolDownMills,
                                   #     Leadership.java:45-46)
+    debug_checks: bool = False    # compile in-kernel invariant checks into
+                                  #     node_step (StepInfo.debug_viol codes;
+                                  #     the vectorized analog of the
+                                  #     reference's ~30 hot-path AssertionErrors,
+                                  #     Follower.java:48-50, Leadership.java:76-81,
+                                  #     RocksLog.java:175-187).  Off by default:
+                                  #     zero cost when False (trace-time branch).
 
     def __post_init__(self):
         assert self.n_peers >= 1
@@ -316,6 +323,9 @@ class StepInfo:
     snap_req_from: jax.Array  # [G] int32 — peer to download from
     snap_req_idx: jax.Array   # [G] int32
     snap_req_term: jax.Array  # [G] int32
+    debug_viol: jax.Array     # [G] int32 — in-kernel invariant violation code
+                              #   (0 = ok; codes in step.py DEBUG_CODES).
+                              #   Always zeros unless cfg.debug_checks.
 
     @classmethod
     def empty(cls, cfg: EngineConfig) -> "StepInfo":
@@ -329,6 +339,7 @@ class StepInfo:
             ready=jnp.zeros((G,), jnp.bool_),
             snap_req=jnp.zeros((G,), jnp.bool_),
             snap_req_from=z(), snap_req_idx=z(), snap_req_term=z(),
+            debug_viol=z(),
         )
 
 

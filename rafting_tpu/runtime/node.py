@@ -126,7 +126,8 @@ class RaftNode:
                  group_queue_cap: int = 512,
                  total_queue_cap: int = 500_000,
                  busy_threshold: int = 1_000,
-                 store=None):
+                 store=None,
+                 serializer=None):
         """``transport_factory(node, on_slice, snapshot_provider)`` builds
         the transport endpoint (TcpTransport / LoopbackTransport).
         ``initial_active`` masks which group lanes start open (default all;
@@ -135,10 +136,16 @@ class RaftNode:
         command/admin/Administrator.java:50-57).
         ``store``: any LogStoreSPI product (log/spi.py; reference StateLoader
         SPI via RaftFactory.loadState, support/RaftFactory.java:18) —
-        default is the durable segmented WAL under ``data_dir``."""
+        default is the durable segmented WAL under ``data_dir``.
+        ``serializer``: CmdSerializer for command/result encoding across
+        the leader-forward relay (api/serial.py; reference CmdSerializer,
+        support/serial/CmdSerializer.java:11-24) — default JSON."""
+        from ..api.serial import JsonSerializer
+
         self.cfg = cfg
         self.node_id = node_id
         self.data_dir = data_dir
+        self.serializer = serializer or JsonSerializer()
         os.makedirs(data_dir, exist_ok=True)
 
         self.store = store if store is not None \
@@ -251,6 +258,13 @@ class RaftNode:
         self.wal_gc_check_ticks = 128
         self.wal_gc_ratio = 4.0
         self.wal_gc_min_bytes = 8 << 20
+        # Hard bound on checkpoint work per tick: whatever the policy says
+        # is due, at most this many machines checkpoint in one tick (the
+        # rest stay due and drain over the following ticks) — maintenance
+        # must never own the tick latency (reference: checkpoints run on a
+        # bounded 5-thread pool off the loop, RaftRoutine.java:46-49).
+        self.max_checkpoints_per_tick = 256
+        self._ckpt_cursor = 0   # round-robin position for the cap above
         # _gc_phase handoff protocol: the tick thread writes 0->1 (start),
         # the worker writes 1->2 or 1->-1 (done/failed), the tick thread
         # consumes 2/-1 back to 0.  Exactly one side may write in each
@@ -532,6 +546,10 @@ class RaftNode:
             (info, outbox, self.state.term, self.state.voted_for,
              self.state.role, self.state.leader_id, self.state.commit,
              self.state.log.base, self.state.log.base_term))
+
+        if cfg.debug_checks:
+            from ..core.step import raise_debug_violations
+            raise_debug_violations(h_info, f"node {self.node_id}")
 
         # i32 lane-overflow guard (core/types.py I32_SAFE_MAX): indices,
         # terms and the tick clock are int32 on device by design — fail
@@ -840,7 +858,16 @@ class RaftNode:
     def _maintain(self, applied: np.ndarray, h_base, h_term) -> None:
         now = self.ticks
         need = self.maintain.need_checkpoint(now, applied, h_base)
-        for g in np.nonzero(need)[0].tolist():
+        due = np.nonzero(need)[0]
+        if len(due) > self.max_checkpoints_per_tick:
+            # Rotate the selection across ticks: a fixed [:cap] slice would
+            # starve high-index groups forever under sustained load.
+            pos = int(np.searchsorted(due, self._ckpt_cursor, side="right"))
+            due = np.concatenate([due[pos:], due[:pos]])
+            due = due[:self.max_checkpoints_per_tick]
+        if len(due):
+            self._ckpt_cursor = int(due[-1])
+        for g in due.tolist():
             try:
                 ckpt = self.dispatcher.machine(g).checkpoint(0)
             except Exception:

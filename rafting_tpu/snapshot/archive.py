@@ -47,6 +47,12 @@ class SnapshotArchive:
         self.retain = retain
         os.makedirs(root, exist_ok=True)
         self._pending: Dict[int, PendingSnapshot] = {}
+        # Hot-path caches: group dirs already created, and the newest
+        # snapshot per group.  Without them every checkpoint/serve does a
+        # makedirs + listdir + sort per call — at a 100k-group maintain
+        # cadence that is hundreds of redundant file ops per tick.
+        self._dirs: set = set()
+        self._newest: Dict[int, Optional[Snapshot]] = {}
         # Sweep temp droppings from interrupted installs.
         for name in os.listdir(root):
             if name.endswith(".tmp"):
@@ -65,7 +71,9 @@ class SnapshotArchive:
 
     def _gdir(self, g: int) -> str:
         d = os.path.join(self.root, f"g{g}")
-        os.makedirs(d, exist_ok=True)
+        if g not in self._dirs:
+            os.makedirs(d, exist_ok=True)
+            self._dirs.add(g)
         return d
 
     # -- local snapshots -----------------------------------------------------
@@ -86,11 +94,23 @@ class SnapshotArchive:
         shutil.copyfile(src_path, tmp)
         os.replace(tmp, dst)
         self._prune(g)
-        return Snapshot(dst, index, term)
+        snap = Snapshot(dst, index, term)
+        self._newest[g] = snap
+        return snap
+
+    _MISS = object()
 
     def last_snapshot(self, g: int) -> Optional[Snapshot]:
+        # Single .get read: the snapshot-serving transport thread calls
+        # this concurrently with the tick thread's destroy(), so a
+        # check-then-index pair could land between the two and KeyError.
+        snap = self._newest.get(g, self._MISS)
+        if snap is not self._MISS:
+            return snap
         snaps = self.list_snapshots(g)
-        return snaps[-1] if snaps else None
+        snap = snaps[-1] if snaps else None
+        self._newest[g] = snap
+        return snap
 
     def list_snapshots(self, g: int) -> List[Snapshot]:
         d = self._gdir(g)
@@ -163,3 +183,5 @@ class SnapshotArchive:
     def destroy(self, g: int) -> None:
         shutil.rmtree(self._gdir(g), ignore_errors=True)
         self._pending.pop(g, None)
+        self._dirs.discard(g)
+        self._newest.pop(g, None)

@@ -30,8 +30,15 @@ class MaintainAgreement:
         self.snap_min_interval = snap_min_interval
         self.compact_min_interval = compact_min_interval
         self.compact_slack = compact_slack
-        self.last_snap_tick = np.zeros(G, np.int64)
-        self.last_compact_tick = np.zeros(G, np.int64)
+        # Phase-stagger the cadences across groups: groups booted together
+        # would otherwise cross their thresholds TOGETHER, turning
+        # maintenance into a synchronized storm (thousands of checkpoint
+        # file copies in one tick — a multi-second stall at 8k+ groups)
+        # instead of a steady trickle.
+        self.last_snap_tick = -(np.arange(G, dtype=np.int64)
+                                % max(snap_min_interval, 1))
+        self.last_compact_tick = -(np.arange(G, dtype=np.int64)
+                                   % max(compact_min_interval, 1))
         self.snap_index = np.zeros(G, np.int64)     # newest archived snapshot
         self.applied_at_snap = np.zeros(G, np.int64)
 

@@ -43,7 +43,8 @@ class LocalCluster:
                  provider_factory: Optional[Callable[[int], object]] = None,
                  seed: int = 0,
                  maintain_factory: Optional[Callable[[], object]] = None,
-                 store_factory: Optional[Callable[[int], object]] = None):
+                 store_factory: Optional[Callable[[int], object]] = None,
+                 serializer_factory: Optional[Callable[[], object]] = None):
         """``provider_factory(node_id)`` returns a MachineProvider; defaults
         to FileMachine per group under ``root/node<i>/machines`` (the
         reference's file-append oracle, cluster/cmd/FileMachine.java).
@@ -51,7 +52,9 @@ class LocalCluster:
         reference test configs' aggressive all-thresholds-1 snapshot cadence,
         test/resources/raft1.xml:22-28).
         ``store_factory(node_id)`` builds a LogStoreSPI product per node
-        (log/spi.py; default: the durable WAL under the node's data dir)."""
+        (log/spi.py; default: the durable WAL under the node's data dir).
+        ``serializer_factory()`` builds a per-node CmdSerializer
+        (api/serial.py; default JSON)."""
         self.cfg = cfg
         self.root = root
         self.seed = seed
@@ -61,6 +64,7 @@ class LocalCluster:
                 os.path.join(root, f"node{i}", "machines")))
         self.maintain_factory = maintain_factory
         self.store_factory = store_factory
+        self.serializer_factory = serializer_factory
         self.nodes: Dict[int, RaftNode] = {}
         for i in range(cfg.n_peers):
             self.start_node(i)
@@ -72,7 +76,9 @@ class LocalCluster:
             return LoopbackTransport(self.net, node_id, self.cfg,
                                      node.template, on_slice,
                                      snapshot_provider,
-                                     submit_handler=node.submit)
+                                     submit_handler=node.submit,
+                                     result_encoder=node.serializer
+                                     .encode_result)
         return build
 
     def start_node(self, i: int) -> RaftNode:
@@ -82,7 +88,9 @@ class LocalCluster:
             self.provider_factory(i), self._factory(i), seed=self.seed,
             maintain=(self.maintain_factory()
                       if self.maintain_factory else None),
-            store=(self.store_factory(i) if self.store_factory else None))
+            store=(self.store_factory(i) if self.store_factory else None),
+            serializer=(self.serializer_factory()
+                        if self.serializer_factory else None))
         node.transport.start()
         self.nodes[i] = node
         return node

@@ -155,15 +155,20 @@ def unpack_fwd_resp(body: bytes) -> Tuple[bool, bytes]:
 
 
 def serve_forward(submit_handler: Optional[Callable], group: int,
-                  payload: bytes, timeout_s: float) -> Tuple[bool, bytes]:
+                  payload: bytes, timeout_s: float,
+                  encode_result: Optional[Callable] = None
+                  ) -> Tuple[bool, bytes]:
     """Shared serve-side forward contract (TCP and loopback): run the
-    submission, JSON-encode the apply result, 'TypeName: msg' on error."""
+    submission, encode the apply result via the node's CmdSerializer
+    (api/serial.py; default JSON), 'TypeName: msg' on error."""
     import json as _json
     if submit_handler is None:
         return False, b"forwarding disabled"
+    if encode_result is None:
+        encode_result = lambda r: _json.dumps(r).encode()
     try:
         fut = submit_handler(group, payload)
-        return True, _json.dumps(fut.result(timeout=timeout_s)).encode()
+        return True, encode_result(fut.result(timeout=timeout_s))
     except Exception as e:
         return False, f"{type(e).__name__}: {e}".encode()
 

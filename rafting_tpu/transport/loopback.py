@@ -49,7 +49,8 @@ class LoopbackTransport:
     def __init__(self, network: LoopbackNetwork, node_id: int, cfg, template,
                  on_slice: Callable,
                  snapshot_provider: Optional[Callable] = None,
-                 submit_handler: Optional[Callable] = None):
+                 submit_handler: Optional[Callable] = None,
+                 result_encoder: Optional[Callable] = None):
         self.net = network
         self.node_id = node_id
         self.cfg = cfg
@@ -57,6 +58,7 @@ class LoopbackTransport:
         self.on_slice = on_slice
         self.snapshot_provider = snapshot_provider
         self.submit_handler = submit_handler
+        self.result_encoder = result_encoder
 
     def start(self) -> None:
         self.net.transports[self.node_id] = self
@@ -87,7 +89,8 @@ class LoopbackTransport:
         t = self.net.transports.get(peer)
         if t is None:
             return False, b"peer down"
-        return codec.serve_forward(t.submit_handler, group, payload, timeout)
+        return codec.serve_forward(t.submit_handler, group, payload, timeout,
+                                   t.result_encoder)
 
     def fetch_snapshot(self, peer: int, group: int, index: int, term: int,
                        dest_path: str, timeout: float = 60.0

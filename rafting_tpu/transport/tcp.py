@@ -116,9 +116,12 @@ class TcpTransport:
                  cfg, template,
                  on_slice: Callable,
                  snapshot_provider: Optional[Callable] = None,
-                 submit_handler: Optional[Callable] = None):
+                 submit_handler: Optional[Callable] = None,
+                 result_encoder: Optional[Callable] = None):
         """``submit_handler(group, payload) -> Future`` serves forwarded
-        client commands (None -> forwards are refused)."""
+        client commands (None -> forwards are refused).
+        ``result_encoder(result) -> bytes`` encodes forwarded apply results
+        (the node's CmdSerializer, api/serial.py; default JSON)."""
         self.node_id = node_id
         self.peers = peers
         self.cfg = cfg
@@ -126,6 +129,7 @@ class TcpTransport:
         self.on_slice = on_slice
         self.snapshot_provider = snapshot_provider
         self.submit_handler = submit_handler
+        self.result_encoder = result_encoder
         self._hello = codec.pack_hello(node_id, cfg.n_groups, cfg.n_peers,
                                        cfg.batch)
         self._senders: Dict[int, PeerSender] = {}
@@ -318,7 +322,7 @@ class TcpTransport:
     def _serve_forward(self, conn: socket.socket, body: bytes):
         group, timeout_s, payload = codec.unpack_fwd_req(body)
         ok, res = codec.serve_forward(self.submit_handler, group, payload,
-                                      timeout_s)
+                                      timeout_s, self.result_encoder)
         conn.sendall(codec.pack_fwd_resp(ok, res))
 
     def _serve_snapshot(self, conn: socket.socket, body: bytes):

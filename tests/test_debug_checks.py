@@ -1,0 +1,109 @@
+"""Debug-mode in-kernel invariant checks (VERDICT r3 #7).
+
+``EngineConfig.debug_checks`` compiles the vectorized analog of the
+reference's hot-path AssertionErrors (Follower.java:48-50,
+Leadership.java:76-81, RocksLog.java:175-187) into ``node_step``:
+violations surface as a per-lane code naming the broken invariant at the
+faulting step, not as downstream divergence.
+
+Covers: a chaos run (partitions + churn) stays violation-free with checks
+on; seeded corrupt states are caught with the right code; the cross-node
+election-safety check fires on a manufactured split brain."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rafting_tpu.core.cluster import DeviceCluster
+from rafting_tpu.core.step import DEBUG_CODES, node_step
+from rafting_tpu.core.types import (
+    CANDIDATE, EngineConfig, HostInbox, I32, LEADER, Messages, init_state,
+)
+
+CFG = EngineConfig(n_groups=16, n_peers=3, log_slots=32, batch=4,
+                   max_submit=4, election_ticks=10, heartbeat_ticks=3,
+                   rpc_timeout_ticks=8, debug_checks=True)
+
+
+def test_chaos_run_clean_under_debug_checks():
+    """Partitions, heals and dense load never trip an invariant (the
+    checks run on EVERY lane of EVERY node each tick)."""
+    rng = np.random.default_rng(5)
+    c = DeviceCluster(CFG, seed=5)
+    for t in range(220):
+        if t % 40 == 17:
+            keep = int(rng.integers(0, 3))
+            c.isolate(keep)
+        elif t % 40 == 34:
+            c.heal()
+        c.tick(submit_n=int(rng.integers(0, CFG.max_submit + 1)))
+    commit = np.asarray(c.states.commit)
+    assert commit.max(axis=0).sum() > 0
+
+
+def _single(cfg):
+    st = init_state(cfg, node_id=0, seed=0)
+    return st, Messages.empty(cfg), HostInbox.empty(cfg)
+
+
+def _viol(cfg, st):
+    _, _, info = node_step(cfg, st, Messages.empty(cfg),
+                           HostInbox.empty(cfg))
+    return np.asarray(info.debug_viol)
+
+
+def test_seeded_commit_past_log_end_caught():
+    cfg = EngineConfig(n_groups=2, n_peers=3, log_slots=16, batch=4,
+                       max_submit=4, election_ticks=50, heartbeat_ticks=3,
+                       debug_checks=True)
+    st, _, _ = _single(cfg)
+    st = st.replace(commit=st.commit.at[1].set(9))   # empty log, commit 9
+    v = _viol(cfg, st)
+    assert v[1] == 2, (v, DEBUG_CODES[2])
+    assert v[0] == 0
+
+
+def test_seeded_ring_overflow_caught():
+    cfg = EngineConfig(n_groups=1, n_peers=3, log_slots=16, batch=4,
+                       max_submit=4, election_ticks=50, heartbeat_ticks=3,
+                       debug_checks=True)
+    st, _, _ = _single(cfg)
+    st = st.replace(log=st.log.replace(last=jnp.full((1,), 20, I32)))
+    assert _viol(cfg, st)[0] == 1
+
+
+def test_seeded_candidate_foreign_ballot_caught():
+    cfg = EngineConfig(n_groups=1, n_peers=3, log_slots=16, batch=4,
+                       max_submit=4, election_ticks=50, heartbeat_ticks=3,
+                       debug_checks=True)
+    st, _, _ = _single(cfg)
+    st = st.replace(role=st.role.at[0].set(CANDIDATE),
+                    term=st.term.at[0].set(3),
+                    voted_for=st.voted_for.at[0].set(2))
+    assert _viol(cfg, st)[0] == 5
+
+
+def test_host_raises_with_code_name():
+    cfg = EngineConfig(n_groups=2, n_peers=3, log_slots=16, batch=4,
+                       max_submit=4, election_ticks=50, heartbeat_ticks=3,
+                       debug_checks=True)
+    from rafting_tpu.core.step import raise_debug_violations
+    st, _, _ = _single(cfg)
+    st = st.replace(commit=st.commit.at[0].set(9))
+    _, _, info = node_step(cfg, st, Messages.empty(cfg),
+                           HostInbox.empty(cfg))
+    with pytest.raises(AssertionError, match="commit passed the log end"):
+        raise_debug_violations(info)
+
+
+def test_cluster_split_brain_caught():
+    c = DeviceCluster(CFG, seed=0)
+    # Manufacture two same-term leaders of group 0 (unreachable through
+    # the protocol; the checker must still catch a kernel regression that
+    # produces it).
+    s = c.states
+    c.states = s.replace(
+        role=s.role.at[0, 0].set(LEADER).at[1, 0].set(LEADER),
+        term=s.term.at[0, 0].set(7).at[1, 0].set(7))
+    with pytest.raises(AssertionError, match="election safety"):
+        c._debug_check(c.last_info)

@@ -11,7 +11,7 @@ from rafting_tpu.core.types import (
     EngineConfig, HostInbox, I32, I32_SAFE_MAX, Messages, init_state,
 )
 from rafting_tpu.core.step import node_step, ring_term_at
-from rafting_tpu.machine.spi import MachineProvider, RaftMachine
+from rafting_tpu.testkit.fixtures import NullProvider
 from rafting_tpu.testkit.harness import LocalCluster
 
 CFG = EngineConfig(n_groups=2, n_peers=3, log_slots=16, batch=4,
@@ -50,38 +50,9 @@ def test_ring_arithmetic_near_bound():
     assert int(ring_term_at(st2.log, st2.log.last)[0]) == 5
 
 
-class _Null(RaftMachine):
-    def __init__(self):
-        self._a = 0
-
-    def last_applied(self):
-        return self._a
-
-    def apply(self, index, payload):
-        self._a = index
-        return index
-
-    def checkpoint(self, must_include):
-        raise NotImplementedError
-
-    def recover(self, ckpt):
-        pass
-
-    def close(self):
-        pass
-
-    def destroy(self):
-        pass
-
-
-class _NullProv(MachineProvider):
-    def bootstrap(self, group):
-        return _Null()
-
-
 def test_runtime_guard_trips_loudly(tmp_path):
     c = LocalCluster(CFG, str(tmp_path),
-                     provider_factory=lambda i: _NullProv())
+                     provider_factory=lambda i: NullProvider())
     try:
         c.tick(2)  # healthy ticks below the bound
         node = c.nodes[0]
