@@ -12,7 +12,8 @@ from concurrent.futures import Future, TimeoutError as _FutTimeout
 from typing import Any, Optional, Union
 
 from .anomaly import (
-    NotLeaderError, ObsoleteContextError, RaftError, WaitTimeoutError,
+    BusyLoopError, NotLeaderError, NotReadyError, ObsoleteContextError,
+    RaftError, WaitTimeoutError,
 )
 
 
@@ -67,10 +68,24 @@ class RaftStub:
             return fut
         return self._forwarded(payload)
 
+    # Synchronous refusals — raised by the node's refusal taxonomy BEFORE
+    # any enqueue, so the command provably never entered a log and a retry
+    # can never double-apply.  Remote refusals are identified by the serve
+    # side's explicit REFUSED: wire marker (codec.serve_forward), never by
+    # exception type alone — a step-down abort of an ACCEPTED command also
+    # raises NotLeaderError and must NOT be retried (it may still commit
+    # cluster-wide; the standard Raft at-most-once contract).
+    _SYNC_REFUSALS = (NotLeaderError, NotReadyError, BusyLoopError)
+
     def _forwarded(self, payload: bytes) -> Future:
         """Relay to the leader from a worker thread (the forward channel is
-        a blocking ephemeral connection).  During an election there may be
-        no leader hint yet — poll briefly instead of failing instantly."""
+        a blocking ephemeral connection).  Elections and readiness are
+        transient: while the submission keeps being REFUSED (locally or by
+        the remote serve side) without ever entering a log, re-resolve the
+        hint and retry until the forward budget runs out instead of
+        bouncing the first refusal to the caller (reference clients chase
+        NotLeaderException hints, support/anomaly/
+        NotLeaderException.java:11-27)."""
         node = self._container._node
         lane = self.lane
         out: Future = Future()
@@ -78,27 +93,44 @@ class RaftStub:
         def run():
             import time as _time
             try:
-                deadline = _time.monotonic() + 5.0
+                overall = _time.monotonic() + 20.0
                 while True:
-                    if node.is_leader(lane):
-                        # leadership landed HERE while we waited: local
-                        # submit (still one attempt, never a resubmit)
-                        fut = node.submit(lane, payload)
-                        res = fut.result(timeout=30)
-                        out.set_result(res)
+                    # Resolve a target: ourselves if leadership landed
+                    # here, else the current hint.
+                    while True:
+                        if node.is_leader(lane):
+                            fut = node.submit(lane, payload)
+                            if fut.done() and isinstance(
+                                    fut.exception(), self._SYNC_REFUSALS):
+                                # Synchronous refusal: never entered the
+                                # log — keep resolving (same treatment as
+                                # a remote REFUSED reply).
+                                if _time.monotonic() >= overall:
+                                    raise fut.exception()
+                                _time.sleep(0.05)
+                                continue
+                            # Accepted (or failed later): one attempt,
+                            # never a resubmit — an abort after acceptance
+                            # may still commit cluster-wide.
+                            out.set_result(fut.result(timeout=30))
+                            return
+                        hint = node.leader_hint(lane)
+                        if hint is not None and hint != node.node_id:
+                            break
+                        if _time.monotonic() >= overall:
+                            raise NotLeaderError(lane, None)
+                        _time.sleep(0.05)
+                    ok, raw = node.transport.forward_submit(
+                        hint, self.lane, payload, timeout=30)
+                    if ok:
+                        out.set_result(node.serializer.decode_result(raw))
                         return
-                    hint = node.leader_hint(lane)
-                    if hint is not None and hint != node.node_id:
-                        break
-                    if _time.monotonic() >= deadline:
-                        raise NotLeaderError(lane, None)
-                    _time.sleep(0.05)
-                ok, raw = node.transport.forward_submit(
-                    hint, self.lane, payload, timeout=30)
-                if not ok:
-                    raise RaftError(
-                        f"forward failed: {raw.decode(errors='replace')}")
-                out.set_result(node.serializer.decode_result(raw))
+                    msg = raw.decode(errors="replace")
+                    if (msg.startswith("REFUSED:")
+                            and _time.monotonic() < overall):
+                        _time.sleep(0.1)
+                        continue
+                    raise RaftError(f"forward failed: {msg}")
             except Exception as e:
                 if not out.done():
                     out.set_exception(e)

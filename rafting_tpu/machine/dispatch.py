@@ -172,6 +172,40 @@ class ApplyDispatcher:
             if (self._payload_window is not None and hi >= idx
                     and self._payload(g, idx) is not None):
                 window = self._payload_window(g, idx, hi - idx + 1)
+            # Fast path: machines exposing apply_batch (SPI, spi.py) take
+            # the locally-available contiguous prefix in ONE call; a short
+            # return (failed entry) falls through to the per-entry loop,
+            # which retries it with full diagnostics.
+            batch_fn = getattr(m, "apply_batch", None)
+            if window is not None and batch_fn is not None:
+                n_have = 0
+                for p in window:
+                    if p is None:
+                        break
+                    n_have += 1
+                if n_have:
+                    try:
+                        results = batch_fn(idx, window[:n_have])
+                    except Exception as e:
+                        # A raising apply_batch must not kill the whole
+                        # tick (the per-entry path catches and retries).
+                        # The machine may have applied a prefix before
+                        # raising: resync from its own frontier, then let
+                        # the per-entry loop below retry the failing
+                        # entry with full diagnostics.
+                        log.warning("apply_batch failed g=%d idx=%d: %s "
+                                    "(falling back to per-entry)", g, idx, e)
+                        results = []
+                    if pg:
+                        for k, r in enumerate(results):
+                            fut = pg.pop(idx + k, None)
+                            if fut is not None and not fut.done():
+                                fut.set_result(r)
+                    if retries:
+                        for k in range(len(results)):
+                            retries.pop((g, idx + k), None)
+                    idx += len(results)
+                    idx = max(idx, m.last_applied() + 1)
             while idx <= hi:
                 payload = (window[idx - before - 1] if window is not None
                            else self._payload(g, idx))

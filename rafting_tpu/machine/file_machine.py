@@ -59,6 +59,21 @@ class FileMachine:
         self._last_applied = index
         return index
 
+    def apply_batch(self, start_index: int, payloads) -> list:
+        """Batched apply (SPI fast path, spi.py): all lines in one write +
+        one flush instead of one syscall pair per entry."""
+        assert start_index == self._last_applied + 1, \
+            f"apply out of order: {start_index} after {self._last_applied}"
+        lines = []
+        for k, payload in enumerate(payloads):
+            line = (payload.decode("utf-8", "replace")
+                    .replace("\\", "\\\\").replace("\n", "\\n"))
+            lines.append(f"{start_index + k}:{line}\n")
+        self._f.write("".join(lines))
+        self._f.flush()
+        self._last_applied = start_index + len(payloads) - 1
+        return list(range(start_index, start_index + len(payloads)))
+
     def checkpoint(self, must_include: int) -> Checkpoint:
         assert self._last_applied >= must_include
         os.fsync(self._f.fileno())

@@ -27,6 +27,19 @@ class RaftMachine(Protocol):
     * :meth:`checkpoint` produces a durable snapshot whose index is at
       least ``must_include`` (may block; called off the apply path).
     * :meth:`recover` atomically replaces state from a checkpoint.
+    * :meth:`apply_batch` (optional — the dispatcher falls back to
+      per-entry :meth:`apply` when absent): apply a CONTIGUOUS run of
+      committed entries starting at ``start_index`` and return their
+      results in order.  May return a SHORTER list than the input if an
+      entry fails — the machine must have applied exactly the returned
+      prefix; the dispatcher then retries the failing entry through
+      :meth:`apply` for full diagnostics.  Lets a machine amortize
+      per-entry overhead (one lock/transaction/fsync per batch — the
+      analog of the reference's batched applyCommand loop over a commit
+      advance, context/RaftRoutine.java:261-306).  CAUTION: a subclass
+      overriding :meth:`apply` on a base that defines ``apply_batch``
+      must override ``apply_batch`` too, or the dispatcher's batch path
+      will bypass the override.
     """
 
     def last_applied(self) -> int: ...

@@ -666,22 +666,33 @@ class RaftNode:
             # per-entry Python work minimal.
             adopt_hi = min(hi, sub_lo - 1) if n_sub else hi
             gap = False
-            for idx in range(lo, adopt_hi + 1):
-                # follower adoption: payload staged with the leader's frame;
-                # term from the same frame's entry-term vector.
-                payload = staged_payloads.get((leader_src, g, idx))
-                term = self._staged_term(inbox_arrays, leader_src, g, idx)
-                if payload is None or term is None:
-                    # Entry accepted on device but its bytes are not
-                    # locally available (e.g. duplicate-delivery edge).
-                    # Stop at the gap: the durable prefix stays contiguous;
-                    # resend will re-deliver.
-                    gap = True
-                    break
-                bat_g.append(g)
-                bat_i.append(idx)
-                bat_t.append(term)
-                bat_p.append(payload)
+            if adopt_hi >= lo:
+                # follower adoption: payloads staged as one contiguous run
+                # per (src, group) with the leader's frame; terms from the
+                # same frame's entry vector.  One dict resolution + one
+                # row materialization per GROUP, then plain list indexing
+                # per entry.
+                run = staged_payloads.get((leader_src, g)) \
+                    if leader_src >= 0 else None
+                terms = self._staged_terms(inbox_arrays, leader_src, g)
+                for idx in range(lo, adopt_hi + 1):
+                    k = idx - run[0] if run is not None else -1
+                    payload = (run[1][k] if run is not None
+                               and 0 <= k < len(run[1]) else None)
+                    kt = idx - terms[0] if terms is not None else -1
+                    term = (terms[1][kt] if terms is not None
+                            and 0 <= kt < len(terms[1]) else None)
+                    if payload is None or term is None:
+                        # Entry accepted on device but its bytes are not
+                        # locally available (e.g. duplicate-delivery
+                        # edge).  Stop at the gap: the durable prefix
+                        # stays contiguous; resend will re-deliver.
+                        gap = True
+                        break
+                    bat_g.append(g)
+                    bat_i.append(idx)
+                    bat_t.append(term)
+                    bat_p.append(payload)
             if n_sub and not gap and hi >= sub_lo:
                 # own accepted submissions, all at our term.
                 cnt = hi - sub_lo + 1
@@ -823,17 +834,19 @@ class RaftNode:
         self._stable_voted_m[np.asarray(lanes)] = -2
 
     @staticmethod
-    def _staged_term(arrays, src: int, g: int, idx: int) -> Optional[int]:
-        """Term of a follower-adopted entry, from the AppendEntries frame the
-        engine just accepted (host-side; no device read)."""
+    def _staged_terms(arrays, src: int, g: int):
+        """Entry-term run (start_index, [terms]) of the AppendEntries frame
+        the engine just accepted for group ``g`` (host-side; no device
+        read).  None when no valid frame is staged."""
         if src < 0 or not arrays:
             return None
         if not arrays["ae_valid"][src, g]:
             return None
-        k = idx - int(arrays["ae_prev_idx"][src, g]) - 1
-        if 0 <= k < int(arrays["ae_n"][src, g]):
-            return int(arrays["ae_ents"][src, g, k])
-        return None
+        n = int(arrays["ae_n"][src, g])
+        if n <= 0:
+            return None
+        start = int(arrays["ae_prev_idx"][src, g]) + 1
+        return start, arrays["ae_ents"][src, g, :n].tolist()
 
     def _payload(self, g: int, idx: int) -> Optional[bytes]:
         return self.store.payload(g, idx)

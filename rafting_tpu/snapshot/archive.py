@@ -109,8 +109,12 @@ class SnapshotArchive:
             return snap
         snaps = self.list_snapshots(g)
         snap = snaps[-1] if snaps else None
-        self._newest[g] = snap
-        return snap
+        # setdefault, not assignment: if the tick thread archived a NEWER
+        # snapshot while this (possibly transport-thread) miss was
+        # listing the directory, its cache entry must win — a stale
+        # write-back here would pin an old/None value until the group's
+        # next checkpoint.
+        return self._newest.setdefault(g, snap)
 
     def list_snapshots(self, g: int) -> List[Snapshot]:
         d = self._gdir(g)

@@ -26,6 +26,10 @@ class NullMachine(RaftMachine):
         self._applied = index
         return index
 
+    def apply_batch(self, start_index: int, payloads) -> list:
+        self._applied = start_index + len(payloads) - 1
+        return list(range(start_index, start_index + len(payloads)))
+
     def checkpoint(self, must_include: int) -> Checkpoint:
         fd, path = tempfile.mkstemp()
         os.write(fd, str(self._applied).encode())

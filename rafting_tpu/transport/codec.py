@@ -160,17 +160,32 @@ def serve_forward(submit_handler: Optional[Callable], group: int,
                   ) -> Tuple[bool, bytes]:
     """Shared serve-side forward contract (TCP and loopback): run the
     submission, encode the apply result via the node's CmdSerializer
-    (api/serial.py; default JSON), 'TypeName: msg' on error."""
+    (api/serial.py; default JSON).
+
+    Error wire format: ``REFUSED:TypeName: msg`` when the submission was
+    refused SYNCHRONOUSLY — the node's refusal taxonomy runs before any
+    enqueue, so the command provably never entered the log and the client
+    may safely retry it elsewhere; ``FAILED:TypeName: msg`` for anything
+    that failed after acceptance (abort on step-down, apply timeout, ...)
+    where the command MAY still commit cluster-wide and a retry could
+    double-apply.  The distinction is the serve side's to make — the
+    exception TYPE alone cannot carry it (a step-down abort also raises
+    NotLeaderError)."""
     import json as _json
     if submit_handler is None:
-        return False, b"forwarding disabled"
+        return False, b"FAILED:forwarding disabled"
     if encode_result is None:
         encode_result = lambda r: _json.dumps(r).encode()
     try:
         fut = submit_handler(group, payload)
+    except Exception as e:
+        return False, f"FAILED:{type(e).__name__}: {e}".encode()
+    refused = fut.done() and fut.exception() is not None
+    try:
         return True, encode_result(fut.result(timeout=timeout_s))
     except Exception as e:
-        return False, f"{type(e).__name__}: {e}".encode()
+        tag = "REFUSED" if refused else "FAILED"
+        return False, f"{tag}:{type(e).__name__}: {e}".encode()
 
 
 def pack_snap_hdr(group: int, index: int, term: int, ok: bool,
@@ -259,13 +274,16 @@ def pack_slice(src: int, fields: Dict[str, np.ndarray],
 def unpack_slice(body: bytes, template: Dict[str, Tuple[np.dtype, tuple]],
                  n_groups: Optional[int] = None
                  ) -> Tuple[int, Dict[str, Tuple[np.ndarray, np.ndarray]],
-                            Dict[Tuple[int, int], bytes]]:
+                            Dict[int, Tuple[int, List[bytes]]]]:
     """Unpack a MSGS body.
 
     ``template`` maps field name -> (dtype, per-group trailing shape), e.g.
     ae_ents -> (int32, (B,)).  Returns (src, {field: (cols, values)},
-    {(group, index): payload}).  ``n_groups`` bounds-checks column ids so a
-    corrupt or shape-mismatched frame can't scatter out of range.
+    {group: (start_index, [payloads])}) — payloads as one contiguous RUN
+    per group (an AE column is always a contiguous index range), so the
+    adoption path does one dict lookup per group instead of one per entry.
+    ``n_groups`` bounds-checks column ids so a corrupt or shape-mismatched
+    frame can't scatter out of range.
     """
     end = len(body)
 
@@ -281,7 +299,7 @@ def unpack_slice(body: bytes, template: Dict[str, Tuple[np.dtype, tuple]],
     src, n_kinds = struct.unpack_from("<IB", body, 0)
     off = struct.calcsize("<IB")
     out: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
-    payloads: Dict[Tuple[int, int], bytes] = {}
+    payloads: Dict[int, Tuple[int, List[bytes]]] = {}
     for _ in range(n_kinds):
         need(struct.calcsize("<BI"), off)
         kid, n_cols = struct.unpack_from("<BI", body, off)
@@ -319,11 +337,12 @@ def unpack_slice(body: bytes, template: Dict[str, Tuple[np.dtype, tuple]],
             starts = ends - lens
             k = 0
             for g, prev, n in zip(cols.tolist(), prevs.tolist(), ns.tolist()):
-                g, base = int(g), int(prev) + 1
-                for j in range(int(n)):
-                    payloads[(g, base + j)] = \
-                        body[off + starts[k]:off + ends[k]]
-                    k += 1
+                n = int(n)
+                if n:
+                    payloads[int(g)] = (int(prev) + 1, [
+                        body[off + starts[k + j]:off + ends[k + j]]
+                        for j in range(n)])
+                    k += n
             off += int(ends[-1]) if total else 0
     return src, out, payloads
 

@@ -54,8 +54,9 @@ class InboxAccumulator:
 
     def merge(self, src: int,
               fields: Dict[str, Tuple[np.ndarray, np.ndarray]],
-              payloads: Dict[Tuple[int, int], bytes]) -> None:
-        """Enqueue one unpacked slice from peer ``src``."""
+              payloads: Dict[int, Tuple[int, list]]) -> None:
+        """Enqueue one unpacked slice from peer ``src`` (payloads as
+        per-group contiguous runs, codec.unpack_slice format)."""
         with self._lock:
             q = self._queues.get(src)
             if q is None:
@@ -65,7 +66,7 @@ class InboxAccumulator:
             q.append((fields, payloads))
 
     def drain(self) -> Tuple[Dict[str, np.ndarray],
-                             Dict[Tuple[int, int, int], bytes]]:
+                             Dict[Tuple[int, int], Tuple[int, list]]]:
         """Pop the oldest queued slice of every source and merge them into
         one dense inbox (different sources occupy disjoint [src, :] rows,
         so one slice per source never collides).  A source whose backlog
@@ -73,13 +74,14 @@ class InboxAccumulator:
         (newest wins per lane) so lag stays bounded.
 
         Returns the dense arrays (ownership transfers to the caller) and
-        the popped slices' payloads keyed (src, group, index)."""
+        the popped slices' payload runs keyed (src, group) — newest-wins
+        per group under collapse, matching the field planes."""
         P, G = self.cfg.n_peers, self.cfg.n_groups
         arrays: Dict[str, np.ndarray] = {
             name: np.zeros((P, G) + trail, dt)
             for name, (dt, trail) in self.template.items()
         }
-        payloads: Dict[Tuple[int, int, int], bytes] = {}
+        payloads: Dict[Tuple[int, int], Tuple[int, list]] = {}
         with self._lock:
             for src, q in self._queues.items():
                 if not q:
@@ -92,8 +94,8 @@ class InboxAccumulator:
                 for fields, pl in batch:
                     for name, (cols, vals) in fields.items():
                         arrays[name][src, cols] = vals
-                    for (g, idx), p in pl.items():
-                        payloads[(src, g, idx)] = p
+                    for g, run in pl.items():
+                        payloads[(src, g)] = run
         return arrays, payloads
 
     @property

@@ -25,6 +25,12 @@ class BytesEchoMachine(NullMachine):
         self._applied = index
         return payload[::-1]
 
+    def apply_batch(self, start_index, payloads):
+        # Must stay consistent with apply (spi.py: a subclass overriding
+        # apply must override an inherited apply_batch too).
+        self._applied = start_index + len(payloads) - 1
+        return [p[::-1] for p in payloads]
+
 
 class BytesProvider(MachineProvider):
     def bootstrap(self, group):

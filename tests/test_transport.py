@@ -53,7 +53,7 @@ def test_codec_roundtrip():
     assert cols.tolist() == [2] and vals.tolist() == [7]
     cols, ents = out["ae_ents"]
     assert ents.shape == (1, CFG.batch) and ents[0, :2].tolist() == [7, 7]
-    assert got_payloads == {(2, 5): b"cmd-5", (2, 6): b"cmd-6"}
+    assert got_payloads == {2: (5, [b"cmd-5", b"cmd-6"])}
     cols, vals = out["rv_prevote"]
     assert cols.tolist() == [5] and bool(vals[0])
 
