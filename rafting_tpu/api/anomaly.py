@@ -16,6 +16,24 @@ class RaftError(Exception):
     """Base for all framework errors (reference RaftException)."""
 
 
+def as_refusal(exc: RaftError) -> RaftError:
+    """Mark an exception as a pre-log REFUSAL: raised before the command
+    could enter any log (the node's refusal taxonomy and queue-bound
+    checks, all of which run before enqueue — plus the rejection sweep
+    over queued-but-never-device-accepted submissions).  Only marked
+    refusals are safe to retry elsewhere; an UNMARKED failure of the same
+    type (e.g. the NotLeaderError aborting an accepted command on
+    step-down) may still commit cluster-wide, and retrying it could
+    double-apply.  The marker travels the forward wire as the REFUSED:
+    prefix (transport/codec.py serve_forward)."""
+    exc.refusal = True
+    return exc
+
+
+def is_refusal(exc: BaseException) -> bool:
+    return bool(getattr(exc, "refusal", False))
+
+
 class NotLeaderError(RaftError):
     """Submission refused: this node does not lead the group.  Carries the
     last known leader for client redirect (reference NotLeaderException,

@@ -12,8 +12,8 @@ from concurrent.futures import Future, TimeoutError as _FutTimeout
 from typing import Any, Optional, Union
 
 from .anomaly import (
-    BusyLoopError, NotLeaderError, NotReadyError, ObsoleteContextError,
-    RaftError, WaitTimeoutError,
+    NotLeaderError, ObsoleteContextError, RaftError, WaitTimeoutError,
+    is_refusal,
 )
 
 
@@ -68,14 +68,17 @@ class RaftStub:
             return fut
         return self._forwarded(payload)
 
-    # Synchronous refusals — raised by the node's refusal taxonomy BEFORE
-    # any enqueue, so the command provably never entered a log and a retry
-    # can never double-apply.  Remote refusals are identified by the serve
-    # side's explicit REFUSED: wire marker (codec.serve_forward), never by
-    # exception type alone — a step-down abort of an ACCEPTED command also
-    # raises NotLeaderError and must NOT be retried (it may still commit
-    # cluster-wide; the standard Raft at-most-once contract).
-    _SYNC_REFUSALS = (NotLeaderError, NotReadyError, BusyLoopError)
+    # Pre-log refusals are identified by the as_refusal marker set at
+    # their creation sites (api/anomaly.py) — never by exception type or
+    # future-completion timing: a step-down abort of an ACCEPTED command
+    # also raises NotLeaderError and must NOT be retried (it may still
+    # commit cluster-wide; the standard Raft at-most-once contract).
+    # Remote refusals carry the marker as the serve side's REFUSED: wire
+    # prefix.  Among refusals, only these TYPES are transient enough to
+    # retry — an ObsoleteContextError (group destroyed) is a refusal too,
+    # but retrying it for the whole budget is futile.
+    _TRANSIENT_REFUSALS = ("NotLeaderError", "NotReadyError",
+                           "BusyLoopError")
 
     def _forwarded(self, payload: bytes) -> Future:
         """Relay to the leader from a worker thread (the forward channel is
@@ -100,13 +103,15 @@ class RaftStub:
                     while True:
                         if node.is_leader(lane):
                             fut = node.submit(lane, payload)
-                            if fut.done() and isinstance(
-                                    fut.exception(), self._SYNC_REFUSALS):
-                                # Synchronous refusal: never entered the
-                                # log — keep resolving (same treatment as
-                                # a remote REFUSED reply).
+                            exc = fut.exception() if fut.done() else None
+                            if (exc is not None and is_refusal(exc)
+                                    and type(exc).__name__
+                                    in self._TRANSIENT_REFUSALS):
+                                # Marked pre-log refusal: never entered
+                                # the log — keep resolving (same
+                                # treatment as a remote REFUSED reply).
                                 if _time.monotonic() >= overall:
-                                    raise fut.exception()
+                                    raise exc
                                 _time.sleep(0.05)
                                 continue
                             # Accepted (or failed later): one attempt,
@@ -126,10 +131,16 @@ class RaftStub:
                         out.set_result(node.serializer.decode_result(raw))
                         return
                     msg = raw.decode(errors="replace")
+                    kind = msg.split(":", 2)[1] if ":" in msg else ""
                     if (msg.startswith("REFUSED:")
+                            and kind in self._TRANSIENT_REFUSALS
                             and _time.monotonic() < overall):
                         _time.sleep(0.1)
                         continue
+                    if msg.startswith("REFUSED:ObsoleteContextError"):
+                        # Permanent refusal: surface the right type
+                        # immediately, matching the local-submit branch.
+                        raise ObsoleteContextError(msg.split(":", 2)[2])
                     raise RaftError(f"forward failed: {msg}")
             except Exception as e:
                 if not out.done():

@@ -205,7 +205,26 @@ class ApplyDispatcher:
                         for k in range(len(results)):
                             retries.pop((g, idx + k), None)
                     idx += len(results)
-                    idx = max(idx, m.last_applied() + 1)
+                    la = m.last_applied()
+                    if la >= idx:
+                        # The machine advanced past the reported results
+                        # (mid-batch failure after a partial apply, or a
+                        # contract violation): those entries DID apply but
+                        # their results are unobservable.  Their promises
+                        # must not hang forever — fail them explicitly,
+                        # like the snapshot-jump path (resume_from).
+                        if pg:
+                            for i in [i for i in pg if idx <= i <= la]:
+                                fut = pg.pop(i)
+                                if not fut.done():
+                                    fut.set_exception(RuntimeError(
+                                        "entry applied; result unavailable"
+                                        " (apply_batch failed mid-batch)"))
+                        if retries:
+                            for key in [k for k in retries
+                                        if k[0] == g and idx <= k[1] <= la]:
+                                del retries[key]
+                        idx = la + 1
             while idx <= hi:
                 payload = (window[idx - before - 1] if window is not None
                            else self._payload(g, idx))

@@ -57,7 +57,7 @@ from ..transport import InboxAccumulator, messages_template
 from ..transport.codec import pack_slice
 from ..api.anomaly import (
     BatchAbortedError, BusyLoopError, NotLeaderError, NotReadyError,
-    ObsoleteContextError,
+    ObsoleteContextError, as_refusal,
 )
 from ..utils.metrics import Metrics
 from ..utils.profiling import TickProfiler
@@ -363,8 +363,8 @@ class RaftNode:
             if (len(q) >= self.group_queue_cap
                     or self._queued_total
                     >= self.total_queue_cap - self.busy_threshold):
-                fut.set_exception(BusyLoopError(
-                    f"group {group}: submission queue full"))
+                fut.set_exception(as_refusal(BusyLoopError(
+                    f"group {group}: submission queue full")))
                 return fut
             q.append((payload, fut))
             self._queued_total += 1
@@ -394,8 +394,8 @@ class RaftNode:
             if (len(q) + len(payloads) > self.group_queue_cap
                     or self._queued_total + len(payloads)
                     > self.total_queue_cap - self.busy_threshold):
-                fut.set_exception(BusyLoopError(
-                    f"group {group}: submission queue full"))
+                fut.set_exception(as_refusal(BusyLoopError(
+                    f"group {group}: submission queue full")))
                 return fut
             q.extend((p, _BatchSlot(batch, k))
                      for k, p in enumerate(payloads))
@@ -404,15 +404,18 @@ class RaftNode:
 
     def _refusal(self, group: int) -> Optional[Exception]:
         """The submission refusal taxonomy, shared by submit/submit_batch
-        (reference: RaftStub.process checks, command/RaftStub.java:79-91)."""
+        (reference: RaftStub.process checks, command/RaftStub.java:79-91).
+        All are marked pre-log refusals: nothing was enqueued, so a retry
+        elsewhere can never double-apply (api/anomaly.py as_refusal)."""
         if not self.h_active[group]:
-            return ObsoleteContextError(f"group {group} closed")
+            return as_refusal(ObsoleteContextError(f"group {group} closed"))
         if self.h_role[group] != LEADER:
             hint = int(self.h_leader[group])
-            return NotLeaderError(group, None if hint == NIL else hint)
+            return as_refusal(
+                NotLeaderError(group, None if hint == NIL else hint))
         if not self.h_ready[group]:
-            return NotReadyError(
-                f"group {group}: leader lacks a healthy majority")
+            return as_refusal(NotReadyError(
+                f"group {group}: leader lacks a healthy majority"))
         return None
 
     def is_leader(self, group: int) -> bool:
@@ -767,11 +770,15 @@ class RaftNode:
 
     def _reject_submissions(self, g: int,
                             exc: Optional[Exception] = None) -> None:
+        """Fail every QUEUED-but-never-device-accepted submission.  These
+        provably never entered the log, so the error is a marked refusal
+        (retry-safe) — unlike dispatcher.abort_promises, which covers
+        commands already accepted into the log."""
         with self._submit_lock:
             q = self._submissions.get(g, [])
             self._submissions[g] = []
             self._queued_total -= len(q)
-        err = exc or NotLeaderError(g, self.leader_hint(g))
+        err = as_refusal(exc or NotLeaderError(g, self.leader_hint(g)))
         for payload, fut in q:
             if not fut.done():
                 fut.set_exception(err)

@@ -162,16 +162,19 @@ def serve_forward(submit_handler: Optional[Callable], group: int,
     submission, encode the apply result via the node's CmdSerializer
     (api/serial.py; default JSON).
 
-    Error wire format: ``REFUSED:TypeName: msg`` when the submission was
-    refused SYNCHRONOUSLY — the node's refusal taxonomy runs before any
-    enqueue, so the command provably never entered the log and the client
-    may safely retry it elsewhere; ``FAILED:TypeName: msg`` for anything
-    that failed after acceptance (abort on step-down, apply timeout, ...)
-    where the command MAY still commit cluster-wide and a retry could
-    double-apply.  The distinction is the serve side's to make — the
-    exception TYPE alone cannot carry it (a step-down abort also raises
-    NotLeaderError)."""
+    Error wire format: ``REFUSED:TypeName: msg`` when the error is a
+    MARKED pre-log refusal (api/anomaly.py as_refusal — set only at the
+    creation sites that provably never enqueued the command), so the
+    client may safely retry it elsewhere; ``FAILED:TypeName: msg`` for
+    anything else (abort on step-down of an accepted command, apply
+    timeout, ...) where the command MAY still commit cluster-wide and a
+    retry could double-apply.  Neither the exception TYPE (a step-down
+    abort also raises NotLeaderError) nor future-completion TIMING (the
+    tick thread can accept AND abort a command between our enqueue and
+    our done() check) can carry the distinction — only the marker can."""
     import json as _json
+
+    from ..api.anomaly import is_refusal
     if submit_handler is None:
         return False, b"FAILED:forwarding disabled"
     if encode_result is None:
@@ -179,12 +182,12 @@ def serve_forward(submit_handler: Optional[Callable], group: int,
     try:
         fut = submit_handler(group, payload)
     except Exception as e:
-        return False, f"FAILED:{type(e).__name__}: {e}".encode()
-    refused = fut.done() and fut.exception() is not None
+        tag = "REFUSED" if is_refusal(e) else "FAILED"
+        return False, f"{tag}:{type(e).__name__}: {e}".encode()
     try:
         return True, encode_result(fut.result(timeout=timeout_s))
     except Exception as e:
-        tag = "REFUSED" if refused else "FAILED"
+        tag = "REFUSED" if is_refusal(e) else "FAILED"
         return False, f"{tag}:{type(e).__name__}: {e}".encode()
 
 

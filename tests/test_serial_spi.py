@@ -101,3 +101,25 @@ def test_json_default_rejects_bytes_result(tmp_path):
         assert not ok and b"TypeError" in raw
     finally:
         c.close()
+
+
+def test_serve_forward_refusal_marker():
+    """Only exceptions MARKED as pre-log refusals (api/anomaly.as_refusal)
+    cross the forward wire as REFUSED (retryable); the same exception
+    TYPE without the marker — e.g. the NotLeaderError aborting an
+    ACCEPTED command on step-down — must be FAILED (a retry could
+    double-apply)."""
+    from concurrent.futures import Future
+
+    from rafting_tpu.api.anomaly import NotLeaderError, as_refusal
+    from rafting_tpu.transport.codec import serve_forward
+
+    f = Future()
+    f.set_exception(as_refusal(NotLeaderError(0, 1)))
+    ok, raw = serve_forward(lambda g, p: f, 0, b"x", 1.0)
+    assert not ok and raw.startswith(b"REFUSED:NotLeaderError")
+
+    f2 = Future()
+    f2.set_exception(NotLeaderError(0, 1))
+    ok, raw = serve_forward(lambda g, p: f2, 0, b"x", 1.0)
+    assert not ok and raw.startswith(b"FAILED:NotLeaderError")
