@@ -60,10 +60,14 @@ class RaftStub:
         payload = node.serializer.encode_command(command)
         if node.is_leader(self.lane) or not self.forward:
             fut = node.submit(self.lane, payload)
-            # A synchronous fast-fail (leadership moved between our check
-            # and the node's) never entered the log: forwarding is safe.
-            if self.forward and fut.done() and \
-                    isinstance(fut.exception(), NotLeaderError):
+            # A MARKED refusal (leadership moved between our check and the
+            # node's) provably never entered the log: forwarding is safe.
+            # The marker is required — an accept-then-abort race can
+            # complete the future with an UNMARKED NotLeaderError for a
+            # command that may still commit (api/anomaly.py as_refusal).
+            exc = fut.exception() if fut.done() else None
+            if (self.forward and exc is not None and is_refusal(exc)
+                    and isinstance(exc, NotLeaderError)):
                 return self._forwarded(payload)
             return fut
         return self._forwarded(payload)
@@ -114,11 +118,23 @@ class RaftStub:
                                     raise exc
                                 _time.sleep(0.05)
                                 continue
-                            # Accepted (or failed later): one attempt,
-                            # never a resubmit — an abort after acceptance
+                            # Accepted (or pending): wait for the result.
+                            # A MARKED transient refusal raised later
+                            # (the queued-but-never-accepted rejection
+                            # sweep on leadership loss) is still
+                            # retry-safe — keep resolving.  Any UNMARKED
+                            # failure surfaces: an abort after acceptance
                             # may still commit cluster-wide.
-                            out.set_result(fut.result(timeout=30))
-                            return
+                            try:
+                                out.set_result(fut.result(timeout=30))
+                                return
+                            except Exception as e:
+                                if (is_refusal(e) and type(e).__name__
+                                        in self._TRANSIENT_REFUSALS
+                                        and _time.monotonic() < overall):
+                                    _time.sleep(0.05)
+                                    continue
+                                raise
                         hint = node.leader_hint(lane)
                         if hint is not None and hint != node.node_id:
                             break

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""BASELINE config 4 validation: 100k groups x 5 peers, mixed
+AppendEntries + RequestVote traffic under partition, on the real device,
+with in-kernel invariant checks compiled in (EngineConfig.debug_checks).
+
+Measured r4 on TPU v5e-1 (seed 4): elect 100k x 5 in ~97s (incl. compile),
+95.7% of majority-side groups re-elect + progress within 30 partitioned
+ticks, 100% by 120; after heal, zero same-term split brain across all
+100k groups and every group progresses.  Total 289s, 87.3M commits.
+
+Usage: python tools/validate_config4.py [n_groups]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import numpy as np
+    import jax
+    from rafting_tpu import DeviceCluster, EngineConfig, LEADER
+
+    G = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    cfg = EngineConfig(n_groups=G, n_peers=5, log_slots=64, batch=8,
+                       max_submit=8, election_ticks=10, heartbeat_ticks=3,
+                       rpc_timeout_ticks=8, debug_checks=True)
+    c = DeviceCluster(cfg, seed=4)
+    t0 = time.time()
+    for _ in range(60):
+        c.tick(submit_n=4)
+    roles = np.asarray(c.states.role)
+    assert ((roles == LEADER).sum(axis=0) == 1).all(), "one leader per group"
+    commit0 = np.asarray(c.states.commit).max(axis=0)
+    assert (commit0 > 0).all()
+    print(f"elect+replicate OK: {G} groups x 5 peers, "
+          f"{time.time() - t0:.0f}s", flush=True)
+
+    # Partition: isolate a 2-node minority; the 3-node majority must keep
+    # committing (deposed-leader groups re-elect behind the partition).
+    c.set_partition([[0, 1, 2], [3, 4]])
+    commit1 = commit0
+    for k in range(6):
+        for _ in range(30):
+            c.tick(submit_n=4)
+        commit1 = np.asarray(c.states.commit)[:3].max(axis=0)
+        frac = float((commit1 > commit0).mean())
+        print(f"  after {30 * (k + 1)} partitioned ticks: "
+              f"{frac * 100:.3f}% of groups progressed", flush=True)
+        if frac == 1.0:
+            break
+    assert (commit1 > commit0).all(), \
+        f"stuck groups: {int((commit1 <= commit0).sum())}"
+
+    c.heal()
+    for _ in range(60):
+        c.tick(submit_n=4)
+    for _ in range(15):
+        c.tick()
+    term = np.asarray(c.states.term)
+    role = np.asarray(c.states.role)
+    for i in range(5):
+        for j in range(i + 1, 5):
+            both = ((role[i] == LEADER) & (role[j] == LEADER)
+                    & (term[i] == term[j]))
+            assert not both.any(), f"same-term split brain: nodes {i},{j}"
+    commit2 = np.asarray(c.states.commit).max(axis=0)
+    assert (commit2 > commit1).all()
+    print(f"config-4 OK on {jax.devices()[0].platform}: no same-term split "
+          f"brain, all {G} groups progressed; total {time.time() - t0:.0f}s, "
+          f"committed={int(commit2.astype(np.int64).sum())}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
