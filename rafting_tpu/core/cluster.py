@@ -71,7 +71,7 @@ def cluster_step(cfg: EngineConfig, states: RaftState, inflight: Messages,
 
 @partial(jax.jit, static_argnums=(0, 3))
 def auto_host_inbox(cfg: EngineConfig, states: RaftState, submit_n: jax.Array,
-                    compact: bool, prev_info: StepInfo) -> HostInbox:
+                    compact, prev_info: StepInfo) -> HostInbox:
     """Build a HostInbox batch [N, ...] for the self-driving harness.
 
     Policy (the steady-state behavior of a host runtime whose state machines
@@ -84,14 +84,30 @@ def auto_host_inbox(cfg: EngineConfig, states: RaftState, submit_n: jax.Array,
     * service snapshot downloads instantly: last tick's ``snap_req`` comes
       back as this tick's ``snap_done`` (the payload-less analog of the
       reference's out-of-band snapshot channel, EventNode.java:122-267).
+
+    ``compact``: False = never; True = every tick (the bench steady state);
+    int K > 1 = every K ticks.  The cadence matters for laggard catch-up
+    under SUSTAINED load: an every-tick floor advances continuously and
+    outruns any snapshot install (each installed milestone is already
+    below the floor by adoption time — a pursuit that never converges),
+    whereas real compaction is gated on discrete checkpoints with minimum
+    intervals (snapshot/policy.py, reference MaintainAgreement.java:
+    85-130), giving laggards a stable window to install and then drain
+    the live log.  Use a cadence when simulating catch-up scenarios.
     """
     G = cfg.n_groups
     slack = cfg.log_slots // 4
 
     def one(st, sub, info):
         hi = HostInbox.empty(cfg)
-        ct = (jnp.maximum(st.commit - slack, 0) if compact
-              else jnp.zeros((G,), jnp.int32))
+        if compact is True:
+            ct = jnp.maximum(st.commit - slack, 0)
+        elif compact:
+            ct = jnp.where(st.now % int(compact) == 0,
+                           jnp.maximum(st.commit - slack, 0),
+                           jnp.zeros((G,), jnp.int32))
+        else:
+            ct = jnp.zeros((G,), jnp.int32)
         return hi.replace(
             submit_n=sub,
             compact_to=ct,
@@ -113,6 +129,10 @@ class DeviceCluster:
     def __init__(self, cfg: EngineConfig, seed: int = 0,
                  n_active: int | None = None):
         self.cfg = cfg
+        # Compaction policy for the self-driving inbox (see
+        # auto_host_inbox): True = every tick, int K = every K ticks,
+        # False = never.  Set a cadence when simulating laggard catch-up.
+        self.compact = True
         N = cfg.n_peers
         states = [init_state(cfg, i, seed=seed, n_active=n_active)
                   for i in range(N)]
@@ -154,7 +174,7 @@ class DeviceCluster:
                 sub = jnp.asarray(submit_n, jnp.int32)
                 if sub.ndim == 0:
                     sub = jnp.broadcast_to(sub, (N, G))
-            host = auto_host_inbox(self.cfg, self.states, sub, True,
+            host = auto_host_inbox(self.cfg, self.states, sub, self.compact,
                                    self.last_info)
         self.states, self.inflight, info = cluster_step(
             self.cfg, self.states, self.inflight, host, self.conn)

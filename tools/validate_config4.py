@@ -55,17 +55,14 @@ def main() -> None:
         f"stuck groups: {int((commit1 <= commit0).sum())}"
 
     c.heal()
+    # Same-term split brain is checked EVERY tick by the harness itself
+    # (debug_checks=True -> DeviceCluster._debug_check's cross-node
+    # election-safety scan) — any violation raises from tick(), so
+    # reaching the end of this run IS the safety result.
     for _ in range(60):
         c.tick(submit_n=4)
     for _ in range(15):
         c.tick()
-    term = np.asarray(c.states.term)
-    role = np.asarray(c.states.role)
-    for i in range(5):
-        for j in range(i + 1, 5):
-            both = ((role[i] == LEADER) & (role[j] == LEADER)
-                    & (term[i] == term[j]))
-            assert not both.any(), f"same-term split brain: nodes {i},{j}"
     commit2 = np.asarray(c.states.commit).max(axis=0)
     assert (commit2 > commit1).all()
     print(f"config-4 OK on {jax.devices()[0].platform}: no same-term split "
