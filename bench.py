@@ -215,10 +215,16 @@ def run_scale(n_groups: int, measure_ticks: int, warmup_ticks: int,
     env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__)) + (
         os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
     if not platform:
-        # A device-scale child must see the default backend: a JAX_PLATFORMS
-        # pin left over from the CPU test workflow (tests/conftest.py,
-        # SKILL.md) would silently benchmark CPU against the TPU baseline.
-        env.pop("JAX_PLATFORMS", None)
+        # A device-scale child must reach the accelerator.  Drop ONLY a
+        # leftover CPU pin (tests/conftest.py, SKILL.md) — an explicit
+        # accelerator pin like 'axon' must be KEPT: the tunneled TPU
+        # registers only under explicit selection, and without the pin the
+        # stock 'tpu' backend probes for LOCAL hardware, fails ("no
+        # jellyfish device found"), and the child silently benchmarks CPU
+        # (observed r4: the tunnel's auto-registration came and went
+        # within one session while the explicit pin kept working).
+        if env.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+            env.pop("JAX_PLATFORMS", None)
     try:
         r = subprocess.run(cmd, capture_output=True, text=True,
                            timeout=timeout_s, env=env)

@@ -11,6 +11,11 @@ import os
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# Stash the launch environment's platform pin before overriding: the
+# opt-in `-m tpu` smoke needs it to reach the real device (the tunneled
+# TPU registers only under explicit selection — see bench.py run_scale).
+os.environ.setdefault("RAFT_ORIG_JAX_PLATFORMS",
+                      os.environ.get("JAX_PLATFORMS", ""))
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402

@@ -96,8 +96,22 @@ def test_tpu_smoke_bench():
     """Opt-in (`pytest -m tpu`): run the real bench child on the default
     backend in a clean subprocess.  Skips if no accelerator is reachable."""
     env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)
-    env["PYTHONPATH"] = REPO
+    # Restore the launch environment's platform pin (stashed by conftest
+    # before it pinned this process to CPU): an explicit accelerator pin
+    # like 'axon' is REQUIRED to reach the tunneled TPU — without it the
+    # stock 'tpu' backend probes local hardware, fails, and the child
+    # silently runs on CPU (see bench.py run_scale).
+    orig = env.pop("RAFT_ORIG_JAX_PLATFORMS", "").strip()
+    if orig and orig.lower() != "cpu":
+        env["JAX_PLATFORMS"] = orig
+    else:
+        env.pop("JAX_PLATFORMS", None)
+    # APPEND the repo to PYTHONPATH — never replace it: the tunneled-TPU
+    # platform itself registers via a PYTHONPATH site entry, so
+    # overwriting the variable silently severs the device and the child
+    # benchmarks CPU.
+    env["PYTHONPATH"] = REPO + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
     try:
         r = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py"), "--child",
