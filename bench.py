@@ -215,16 +215,11 @@ def run_scale(n_groups: int, measure_ticks: int, warmup_ticks: int,
     env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__)) + (
         os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
     if not platform:
-        # A device-scale child must reach the accelerator.  Drop ONLY a
-        # leftover CPU pin (tests/conftest.py, SKILL.md) — an explicit
-        # accelerator pin like 'axon' must be KEPT: the tunneled TPU
-        # registers only under explicit selection, and without the pin the
-        # stock 'tpu' backend probes for LOCAL hardware, fails ("no
-        # jellyfish device found"), and the child silently benchmarks CPU
-        # (observed r4: the tunnel's auto-registration came and went
-        # within one session while the explicit pin kept working).
-        if env.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
-            env.pop("JAX_PLATFORMS", None)
+        # A device-scale child must reach the accelerator: drop only a
+        # leftover CPU pin, keep an explicit accelerator pin (the one
+        # shared rule — see the helper's docstring).
+        from __graft_entry__ import _drop_cpu_pin
+        _drop_cpu_pin(env)
     try:
         r = subprocess.run(cmd, capture_output=True, text=True,
                            timeout=timeout_s, env=env)

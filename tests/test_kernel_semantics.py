@@ -195,3 +195,48 @@ def test_commit_only_own_term():
     st3 = st3.replace(match_idx=jnp.asarray([[3, 3, 3]], I32))
     st4, _, _ = node_step(cfg, st3, Messages.empty(cfg), HostInbox.empty(cfg))
     assert int(st4.commit[0]) == 3, "own-term cover commits the whole prefix"
+
+
+def test_heartbeat_reply_echoes_empty_flag():
+    """Replies to empty AEs (heartbeats) carry aer_empty=True, data AEs
+    False — the occupancy echo that keeps the sender's in-flight window
+    exact (phase 9 window exemption)."""
+    cfg = cfg3()
+    st = follower_with_log(cfg, term=2, entry_terms=[1, 1, 1])
+    hb = ae_from(cfg, peer=1, term=2, prev_idx=3, prev_term=1, n=0)
+    _, out, _ = node_step(cfg, st, hb, HostInbox.empty(cfg))
+    assert bool(out.aer_empty[1, 0]) and bool(out.aer_success[1, 0])
+
+    st = follower_with_log(cfg, term=2, entry_terms=[1, 1, 1])
+    data = ae_from(cfg, peer=1, term=2, prev_idx=3, prev_term=1, n=1,
+                   ents=[2])
+    _, out, _ = node_step(cfg, st, data, HostInbox.empty(cfg))
+    assert not bool(out.aer_empty[1, 0]) and bool(out.aer_success[1, 0])
+
+
+def test_full_window_still_emits_heartbeats():
+    """A leader whose data window is saturated still emits empty AEs on
+    the heartbeat cadence (slot-exempt; the starvation fix the wedged-
+    window cluster test covers end to end — this pins the kernel-level
+    contract directly)."""
+    cfg = cfg3(heartbeat_ticks=1, rpc_timeout_ticks=40)
+    st = follower_with_log(cfg, term=3, entry_terms=[3, 3, 3, 3])
+    G, P = 1, cfg.n_peers
+    st = st.replace(
+        role=jnp.full((G,), LEADER, I32),
+        leader_id=jnp.zeros((G,), I32),
+        # Window full on both peers; nothing new to send.
+        inflight=jnp.full((G, P), cfg.inflight_limit, I32),
+        send_next=jnp.full((G, P), 5, I32),
+        next_idx=jnp.full((G, P), 1, I32),
+        sent_at=jnp.zeros((G, P), I32),
+        hb_due=jnp.zeros((G,), I32),
+    )
+    st2, out, _ = node_step(cfg, st, Messages.empty(cfg),
+                            HostInbox.empty(cfg))
+    # Heartbeats to both real peers despite the saturated window...
+    assert bool(out.ae_valid[1, 0]) and bool(out.ae_valid[2, 0])
+    assert int(out.ae_n[1, 0]) == 0 and int(out.ae_n[2, 0]) == 0
+    # ...without occupying data slots or spawning hb slots past the cap.
+    assert int(st2.inflight[0, 1]) == cfg.inflight_limit
+    assert int(st2.hb_inflight[0, 1]) == 0

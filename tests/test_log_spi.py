@@ -88,3 +88,29 @@ def test_factory_log_store_hook(tmp_path):
         assert isinstance(node.store, MemoryLogStore)
     finally:
         node.close()
+
+
+def test_memstore_node_crash_restarts_empty_and_catches_up(tmp_path):
+    """A MemoryLogStore node that crashes loses everything BY DESIGN; on
+    restart it must rejoin as a blank follower and converge via normal
+    replication/snapshot catch-up (the resilience contract a swapped
+    non-durable tier still gets from the protocol)."""
+    c = LocalCluster(CFG, str(tmp_path),
+                     store_factory=lambda i: MemoryLogStore())
+    try:
+        c.submit_via_leader(0, b"before-crash")
+        lead = c.leader_of(0)
+        victim = next(i for i in c.nodes if i != lead)
+        c.kill_node(victim)
+        for k in range(6):
+            c.submit_via_leader(0, f"during-{k}".encode())
+        v = c.restart_node(victim)
+        assert v.store.tail(0) == 0, "memory store must restart empty"
+        c.submit_via_leader(0, b"after-restart")
+        c.tick_until(
+            lambda: int(v.h_commit[0]) > 0
+            and int(v.h_commit[0]) >= int(c.nodes[c.leader_of(0)]
+                                          .h_commit[0]) - 1,
+            500, "blank memstore node catch-up")
+    finally:
+        c.close()
