@@ -63,13 +63,28 @@ class LogStore:
         """Stage a whole tick's appends across all groups in one engine
         call (native: one ctypes crossing; the batching analog of the
         reference's group-commit WAL flush, RocksLog flushWal after a
-        batch, command/storage/RocksLog.java:87,195)."""
+        batch, command/storage/RocksLog.java:87,195).
+
+        Cache maintenance is bulked per same-group RUN (the runtime stages
+        each group's entries contiguously): one dict resolution + one
+        C-speed ``update`` per run instead of per-entry Python — the
+        per-entry loop here was ~15% of the durable tick under dense load.
+        Non-contiguous batches remain correct (runs just get shorter)."""
         self.wal.append_batch(groups, idxs, terms, payloads)
-        for g, i, p in zip(groups, idxs, payloads):
-            g, i = int(g), int(i)
-            self._cache.setdefault(g, {})[i] = p
-            if i > self._durable_tail.get(g, 0):
-                self._durable_tail[g] = i
+        n = len(groups)
+        start = 0
+        while start < n:
+            g = int(groups[start])
+            end = start + 1
+            while end < n and groups[end] == g:
+                end += 1
+            run = [int(i) for i in idxs[start:end]]
+            self._cache.setdefault(g, {}).update(
+                zip(run, payloads[start:end]))
+            hi = max(run)
+            if hi > self._durable_tail.get(g, 0):
+                self._durable_tail[g] = hi
+            start = end
 
     def truncate_to(self, g: int, tail: int) -> None:
         """Ensure the durable suffix beyond `tail` dies (conflict/snapshot
@@ -175,16 +190,19 @@ class LogStore:
                         ) -> List[Optional[bytes]]:
         """Payloads for [start, start+n) with None where absent — one
         cache-dict resolution for the whole window (the replication pack
-        path calls this once per AE column instead of once per entry)."""
+        path calls this once per AE column instead of once per entry).
+        The all-cached common case is a single comprehension; WAL reads
+        only run for the (rare) misses."""
         gc = self._cache.setdefault(g, {})
-        out: List[Optional[bytes]] = []
-        for idx in range(start, start + n):
-            p = gc.get(idx)
-            if p is None:
-                p = self.wal.entry_payload(g, idx)
-                if p is not None:
-                    gc[idx] = p
-            out.append(p)
+        get = gc.get
+        out: List[Optional[bytes]] = [get(i) for i in range(start, start + n)]
+        if None in out:
+            for k, p in enumerate(out):
+                if p is None:
+                    p = self.wal.entry_payload(g, start + k)
+                    if p is not None:
+                        gc[start + k] = p
+                        out[k] = p
         return out
 
     def entry_term(self, g: int, idx: int) -> int:
