@@ -215,3 +215,58 @@ def test_maintain_policy_compaction_gated_on_snapshot():
     ma.note_checkpoint(1, now=10, index=49)
     t = ma.compact_targets(15, commit, base)
     assert t[1] == 48  # min(snap=49, commit-slack=48)
+
+
+def test_apply_batch_partial_failure_resolves_promises(tmp_path):
+    """apply_batch that RAISES mid-batch after partially applying: the
+    raise discards every result the batch would have returned, so the
+    dispatcher must fail the applied entries' promises loudly ("result
+    unavailable", never a hang), resync from the machine's own frontier,
+    and resume the remainder normally (machine/dispatch.py batch fast
+    path; the lossless alternative is the short-return contract)."""
+    from rafting_tpu.testkit.fixtures import NullMachine, NullProvider
+
+    class PartialBatchMachine(NullMachine):
+        def __init__(self):
+            super().__init__()
+            self.fail_once_at = 3
+
+        def apply_batch(self, start_index, payloads):
+            out = []
+            for k, p in enumerate(payloads):
+                idx = start_index + k
+                if idx == self.fail_once_at:
+                    self.fail_once_at = None
+                    # Contract breach on purpose: the entry APPLIED but
+                    # the exception loses its result.
+                    self._applied = idx
+                    raise RuntimeError("burp after applying")
+                out.append(self.apply(idx, p))
+            return out
+
+    class Prov(NullProvider):
+        def bootstrap(self, group):
+            return PartialBatchMachine()
+
+    store = {(0, i): b"p%d" % i for i in range(1, 7)}
+    d = ApplyDispatcher(Prov(), lambda g, i: store.get((g, i)),
+                        payload_window_fn=lambda g, s, n:
+                        [store.get((g, s + k)) for k in range(n)])
+    futs = {i: Future() for i in range(1, 7)}
+    for i, f in futs.items():
+        d.register_promise(0, i, f)
+    d.advance(np.array([6], np.int32))
+    # A RAISING apply_batch discards every result it would have returned
+    # (Python loses the return value), so entries 1..3 — all applied per
+    # the machine's own frontier — fail LOUDLY with "result unavailable"
+    # instead of hanging forever.
+    for i in (1, 2, 3):
+        assert futs[i].done(), f"promise {i} left hanging"
+        with pytest.raises(RuntimeError, match="result unavailable"):
+            futs[i].result(timeout=0)
+    # The remainder resumes (same tick or the next advance) with results.
+    d.advance(np.array([6], np.int32))
+    assert d.applied(0) == 6
+    for i in (4, 5, 6):
+        assert futs[i].result(timeout=0) == i
+    d.close()
