@@ -31,6 +31,9 @@ import subprocess
 import sys
 import time
 
+ARTIFACT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "artifacts")
+
 SCALES = (1_024, 4_096, 16_384, 32_768, 65_536, 100_000)
 BASELINE_CPS = 1_000_000  # BASELINE.md: >1M commits/sec @100k groups, v5e-1
 FALSY = ("", "0", "false", "no", "off")
@@ -201,6 +204,46 @@ def emit(line: dict) -> None:
     print(json.dumps(line), flush=True)
 
 
+def save_artifact(res: dict, child_env: dict | None = None,
+                  extra_env: dict | None = None, note: str = "") -> None:
+    """Persist one successful scale's raw result as a committed-to-repo
+    artifact: artifacts/bench_<platform>_<scale>_<seq>.json.  The r1-r4
+    story was device numbers living only in README prose / commit messages
+    — driver capture windows hit tunnel wedges and banked nothing.  With
+    every successful run writing its raw result + config + env knobs to a
+    file the builder commits, a TPU ladder survives as auditable evidence
+    no matter what the capture window later sees (the reference's
+    verification ethos is artifact-driven, /root/reference/README.md:28-33).
+    Best-effort: artifact IO must never kill the bench itself."""
+    try:
+        os.makedirs(ARTIFACT_DIR, exist_ok=True)
+        stem = f"bench_{res.get('platform', 'unknown')}_{res.get('scale', 0)}"
+        seq = 0
+        while os.path.exists(
+                os.path.join(ARTIFACT_DIR, f"{stem}_{seq:03d}.json")):
+            seq += 1
+        doc = {
+            "result": res,
+            "note": note,
+            "seed": 0,                       # DeviceCluster(cfg, seed=0)
+            "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            # The CHILD's effective environment, not the parent's: the
+            # fallback child is env-pinned to cpu and a device child may
+            # have had a cpu pin dropped — recording os.environ would
+            # misstate the platform for exactly the runs that matter.
+            "env": {k: v for k, v in (child_env or os.environ).items()
+                    if k.startswith("BENCH_") or k == "JAX_PLATFORMS"},
+            "extra_env": extra_env or {},
+            "argv": sys.argv[1:],
+        }
+        path = os.path.join(ARTIFACT_DIR, f"{stem}_{seq:03d}.json")
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        sys.stderr.write(f"[bench] artifact saved: {path}\n")
+    except OSError as e:
+        sys.stderr.write(f"[bench] artifact save failed: {e}\n")
+
+
 def run_scale(n_groups: int, measure_ticks: int, warmup_ticks: int,
               timeout_s: float, platform: str = "",
               profile_dir: str = "", extra_env: dict | None = None
@@ -220,6 +263,14 @@ def run_scale(n_groups: int, measure_ticks: int, warmup_ticks: int,
         # shared rule — see the helper's docstring).
         from __graft_entry__ import _drop_cpu_pin
         _drop_cpu_pin(env)
+    elif platform == "cpu":
+        # The last-resort fallback must be wedge-proof: the in-child
+        # programmatic pin (child_run) is NOT sufficient when the tunnel's
+        # sitecustomize pre-imports jax — the r4 tail shows exactly this
+        # child stuck in jax.devices() and the whole artifact came out
+        # empty.  Pin the env TOO, byte-for-byte the working pattern of
+        # __graft_entry__.dryrun_multichip's CPU-mesh subprocess.
+        env["JAX_PLATFORMS"] = "cpu"
     try:
         r = subprocess.run(cmd, capture_output=True, text=True,
                            timeout=timeout_s, env=env)
@@ -241,11 +292,13 @@ def run_scale(n_groups: int, measure_ticks: int, warmup_ticks: int,
         run_scale.last_failure = f"device child failed rc={r.returncode}"
         return None
     try:
-        return json.loads(r.stdout.strip().splitlines()[-1])
+        res = json.loads(r.stdout.strip().splitlines()[-1])
     except (json.JSONDecodeError, IndexError):
         sys.stderr.write(f"[bench] scale {n_groups}: unparseable output: "
                          f"{r.stdout[-500:]!r}\n")
         return None
+    save_artifact(res, child_env=env, extra_env=extra_env)
+    return res
 
 
 def main() -> None:
@@ -269,8 +322,38 @@ def main() -> None:
     budget = float(os.environ.get("BENCH_TOTAL_BUDGET", "2200"))
     t_start = time.monotonic()
 
+    # Pre-probe the device tunnel (throwaway subprocess under a hard
+    # timeout — a wedged backend hangs jax.devices() forever, and that
+    # hang is exactly what starved r4's fallback: every ladder child
+    # burned its full timeout against a known-dead backend until the
+    # driver's window closed with an EMPTY artifact).  One bounded-backoff
+    # retry covers a transient wedge; if the tunnel is down both times the
+    # device ladder is skipped entirely and the CPU fallback runs with
+    # plenty of budget left.
+    from __graft_entry__ import _PROBE, _probe_default_backend
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        device_ok = False
+        probe_why = "operator pinned JAX_PLATFORMS=cpu"
+    else:
+        count, plat = _probe_default_backend()
+        if count == 0:
+            backoff = float(os.environ.get("BENCH_PROBE_BACKOFF", "45"))
+            sys.stderr.write(f"[bench] device probe failed; one retry in "
+                             f"{backoff:.0f}s\n")
+            time.sleep(backoff)
+            _PROBE.clear()
+            count, plat = _probe_default_backend()
+        device_ok = count > 0
+        probe_why = (f"device probe: {count} x {plat or 'none'}" if count
+                     else "device backend unreachable (probe timed out "
+                          "twice, bounded backoff between)")
+    sys.stderr.write(f"[bench] {probe_why}\n")
+
     best = None
     best_is_tuned = False
+    if not device_ok:
+        scales = []   # straight to the CPU fallback below
+        run_scale.last_failure = probe_why
     for i, g in enumerate(scales):
         is_smoke = (i == 0 and only is None)
         timeout_s = smoke_timeout if i == 0 else scale_timeout
@@ -283,28 +366,7 @@ def main() -> None:
                         profile_dir="" if is_smoke else profile_dir)
         if res is None:
             if best is None and i == 0:
-                # Even the smoke scale can't reach the device (wedged
-                # backend).  Emit a CPU number so the artifact has data.
-                sys.stderr.write("[bench] device unreachable — CPU fallback\n")
-                # Answer the headline question (or the explicitly requested
-                # scale) on CPU: ~50s at 100k groups via the blocked runner.
-                fb_scale = only if only else 100_000
-                fb_timeout = max(
-                    60, min(300, budget - (time.monotonic() - t_start)))
-                # Tuned pipeline budget, applied all-or-nothing: mixing
-                # tuned values with operator-pinned ones could produce an
-                # invalid hybrid (e.g. batch > log_slots) and kill the
-                # last-resort fallback.
-                tuned = ({} if any(k in os.environ for k in TUNED_ENV)
-                         else TUNED_ENV)
-                why = getattr(run_scale, "last_failure", "device unreachable")
-                res = run_scale(fb_scale, 96, 48, fb_timeout, platform="cpu",
-                                extra_env=tuned)
-                if res is not None:
-                    best = res
-                    best_is_tuned = bool(tuned)
-                    emit(headline(best, fallback=why, tuned=bool(tuned)))
-                break
+                break   # smoke failed: CPU fallback below
             # A mid-ladder failure costs that scale only (bounded by its
             # timeout): larger scales may still succeed.
             continue
@@ -312,6 +374,29 @@ def main() -> None:
         sys.stderr.write(f"[bench] scale {g}: {res['cps']:,.0f} commits/s "
                          f"({res['platform']}, warmup {res['warmup_s']}s)\n")
         emit(headline(best))
+
+    if best is None:
+        # Device ladder skipped (dead tunnel) or its smoke scale failed.
+        # Emit a CPU number so the artifact is NEVER empty; the child is
+        # env-pinned to CPU (see run_scale) so a wedged tunnel cannot hang
+        # it, and the probe-first structure means nearly the whole budget
+        # is still available here.
+        sys.stderr.write("[bench] device unreachable — CPU fallback\n")
+        fb_scale = only if only else 100_000
+        fb_timeout = max(
+            60, min(300, budget - (time.monotonic() - t_start)))
+        # Tuned pipeline budget, applied all-or-nothing: mixing tuned
+        # values with operator-pinned ones could produce an invalid hybrid
+        # (e.g. batch > log_slots) and kill the last-resort fallback.
+        tuned = ({} if any(k in os.environ for k in TUNED_ENV)
+                 else TUNED_ENV)
+        why = getattr(run_scale, "last_failure", "device unreachable")
+        res = run_scale(fb_scale, 96, 48, fb_timeout, platform="cpu",
+                        extra_env=tuned)
+        if res is not None:
+            best = res
+            best_is_tuned = bool(tuned)
+            emit(headline(best, fallback=why, tuned=bool(tuned)))
 
     if best is None:
         emit({"metric": "AppendEntries commits/sec (no scale survived — "
@@ -345,7 +430,8 @@ def main() -> None:
                           extra_note="" if extra_env is TUNED_ENV else tag))
             best = res
 
-    if best["scale"] == scales[-1] and only is None and not best_is_tuned:
+    if (scales and best["scale"] == scales[-1] and only is None
+            and not best_is_tuned):
         bonus_timeout = float(os.environ.get("BENCH_BONUS_TIMEOUT", "420"))
         if (best["platform"] != "cpu"
                 and "BENCH_USE_PALLAS" not in os.environ):

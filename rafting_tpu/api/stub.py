@@ -18,7 +18,8 @@ from .anomaly import (
 
 
 class RaftStub:
-    def __init__(self, container, name: str, lane: int, forward: bool = True):
+    def __init__(self, container, name: str, lane: int, forward: bool = True,
+                 forward_budget: float = 20.0):
         """``forward=True`` relays submissions to the current leader over
         the transport when this node is a follower, instead of bouncing
         NotLeader back to the caller (the reference only returns the hint,
@@ -26,11 +27,18 @@ class RaftStub:
         forwarded results travel through the node's CmdSerializer
         (api/serial.py; JSON by default — plug RawSerializer or your own
         for arbitrary result types, the reference CmdSerializer contract,
-        support/serial/CmdSerializer.java:11-24)."""
+        support/serial/CmdSerializer.java:11-24).
+
+        ``forward_budget``: overall retry deadline (seconds) for chasing
+        leader hints when no explicit per-call timeout is given;
+        ``execute(timeout=...)`` overrides it per call, and every
+        per-attempt wait is capped by the remaining budget — worst-case
+        caller latency is the budget, not budget + a trailing attempt."""
         self._container = container
         self.name = name
         self._lane = lane
         self.forward = forward
+        self.forward_budget = forward_budget
         self._closed = False
 
     @property
@@ -44,10 +52,13 @@ class RaftStub:
         self._lane = cur
         return cur
 
-    def submit(self, command: Union[bytes, str]) -> Future:
+    def submit(self, command: Union[bytes, str],
+               timeout: Optional[float] = None) -> Future:
         """Async submit (reference RaftStub.submit -> Promise,
         command/RaftStub.java:65-74).  The future resolves with the state
         machine's apply result, or NotLeaderError with a redirect hint.
+        ``timeout`` (when given) bounds the forward-retry budget for this
+        call; it does NOT bound how long the returned future may pend.
 
         At-most-once per call: if a LOCAL submit is accepted and later
         aborted by a leadership change, it is NOT auto-forwarded — the
@@ -68,9 +79,9 @@ class RaftStub:
             exc = fut.exception() if fut.done() else None
             if (self.forward and exc is not None and is_refusal(exc)
                     and isinstance(exc, NotLeaderError)):
-                return self._forwarded(payload)
+                return self._forwarded(payload, timeout)
             return fut
-        return self._forwarded(payload)
+        return self._forwarded(payload, timeout)
 
     # Pre-log refusals are identified by the as_refusal marker set at
     # their creation sites (api/anomaly.py) — never by exception type or
@@ -84,7 +95,8 @@ class RaftStub:
     _TRANSIENT_REFUSALS = ("NotLeaderError", "NotReadyError",
                            "BusyLoopError")
 
-    def _forwarded(self, payload: bytes) -> Future:
+    def _forwarded(self, payload: bytes,
+                   budget: Optional[float] = None) -> Future:
         """Relay to the leader from a worker thread (the forward channel is
         a blocking ephemeral connection).  Elections and readiness are
         transient: while the submission keeps being REFUSED (locally or by
@@ -92,15 +104,26 @@ class RaftStub:
         hint and retry until the forward budget runs out instead of
         bouncing the first refusal to the caller (reference clients chase
         NotLeaderException hints, support/anomaly/
-        NotLeaderException.java:11-27)."""
+        NotLeaderException.java:11-27).  ``budget`` (default the stub's
+        forward_budget) is the OVERALL deadline: every per-attempt wait
+        below is capped by what remains of it."""
         node = self._container._node
         lane = self.lane
         out: Future = Future()
+        total = self.forward_budget if budget is None else budget
 
         def run():
             import time as _time
+            overall = _time.monotonic() + total
+
+            def left() -> float:
+                # Per-attempt cap: never let one blocking wait overrun the
+                # overall deadline (a fixed 30s attempt made worst-case
+                # latency ~budget + 30s).  Floor keeps a just-expiring
+                # budget from turning into a zero-timeout busy loop.
+                return max(0.05, overall - _time.monotonic())
+
             try:
-                overall = _time.monotonic() + 20.0
                 while True:
                     # Resolve a target: ourselves if leadership landed
                     # here, else the current hint.
@@ -126,8 +149,15 @@ class RaftStub:
                             # failure surfaces: an abort after acceptance
                             # may still commit cluster-wide.
                             try:
-                                out.set_result(fut.result(timeout=30))
+                                out.set_result(fut.result(timeout=left()))
                                 return
+                            except _FutTimeout:
+                                # Accepted but not resolved inside the
+                                # budget: the command may still commit —
+                                # report the timeout, never resubmit.
+                                raise WaitTimeoutError(
+                                    f"forwarded command on {self.name!r} "
+                                    f"not resolved in {total}s")
                             except Exception as e:
                                 if (is_refusal(e) and type(e).__name__
                                         in self._TRANSIENT_REFUSALS
@@ -142,7 +172,7 @@ class RaftStub:
                             raise NotLeaderError(lane, None)
                         _time.sleep(0.05)
                     ok, raw = node.transport.forward_submit(
-                        hint, self.lane, payload, timeout=30)
+                        hint, self.lane, payload, timeout=left())
                     if ok:
                         out.set_result(node.serializer.decode_result(raw))
                         return
@@ -169,8 +199,10 @@ class RaftStub:
     def execute(self, command: Union[bytes, str],
                 timeout: Optional[float] = None) -> Any:
         """Blocking submit (reference RaftStub.execute,
-        command/RaftStub.java:47-58)."""
-        fut = self.submit(command)
+        command/RaftStub.java:47-58).  ``timeout`` bounds the whole call,
+        INCLUDING any forward-retry chase (the per-call budget the
+        advisor's r4 finding asked for)."""
+        fut = self.submit(command, timeout=timeout)
         try:
             return fut.result(timeout=timeout)
         except _FutTimeout:

@@ -438,11 +438,19 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     next_idx = jnp.maximum(nx, log.base[:, None] + 1)
     # Pipeline accounting: data-batch replies release a data slot,
     # heartbeat replies (echoed as aer_empty) release a heartbeat slot —
-    # the two occupancy lanes never cross, so the window count stays
-    # exact even though window-full heartbeats go out slot-exempt (phase
-    # 9).  A rejection aborts the whole window so replication resumes
-    # from the clamped next_idx (reference: nextIndex rollback cancels
-    # optimistic sends, Leadership.updateIndex:75-114).
+    # the two occupancy lanes never cross.  Within the heartbeat lane the
+    # count is CONSERVATIVE, not exact: aer_empty is inferred from
+    # ae_n==0, so a reply to a slot-EXEMPT heartbeat (sent while the
+    # window was full, phase 9) is indistinguishable from a reply to an
+    # OCCUPYING one and can release a slot whose own ack was lost.  The
+    # effect is bounded flow-control slack — the RPC-timeout detector for
+    # that peer re-arms on the next occupying heartbeat (one cadence
+    # later); counters clamp at 0 and Raft safety is untouched.  Making
+    # it exact needs an occupied/exempt flag echoed on the AE itself
+    # (symmetric with is_probe) — a wire-schema field not worth the cost
+    # at this severity.  A rejection aborts the whole window so
+    # replication resumes from the clamped next_idx (reference: nextIndex
+    # rollback cancels optimistic sends, Leadership.updateIndex:75-114).
     aer_ack = aer_r & ~inbox.aer_empty.T
     aer_hb_ack = aer_r & inbox.aer_empty.T
     inflight = jnp.where(aer_ack, jnp.maximum(inflight - 1, 0), inflight)

@@ -15,6 +15,7 @@ import dataclasses
 import os
 import re
 import shutil
+import threading
 from typing import Dict, List, Optional
 
 _NAME = re.compile(r"^snapshot_([0-9a-f]{16})_([0-9a-f]{16})$")
@@ -53,6 +54,15 @@ class SnapshotArchive:
         # cadence that is hundreds of redundant file ops per tick.
         self._dirs: set = set()
         self._newest: Dict[int, Optional[Snapshot]] = {}
+        # Per-group incarnation counter bumped by destroy(), plus the lock
+        # that makes check-gen-then-cache atomic against it: a cache-miss
+        # read that overlapped a destroy must not write its (now dead)
+        # listing back into _newest — see last_snapshot.  The lock guards
+        # ONLY the miss write-back and destroy's pop+bump (cache hits stay
+        # lock-free; a hit racing destroy is the pre-existing bounded
+        # hand-out-then-check-exists race every caller already handles).
+        self._gen: Dict[int, int] = {}
+        self._gen_lock = threading.Lock()
         # Sweep temp droppings from interrupted installs.
         for name in os.listdir(root):
             if name.endswith(".tmp"):
@@ -107,19 +117,39 @@ class SnapshotArchive:
         snap = self._newest.get(g, self._MISS)
         if snap is not self._MISS:
             return snap
+        gen = self._gen.get(g, 0)
         snaps = self.list_snapshots(g)
         snap = snaps[-1] if snaps else None
-        # setdefault, not assignment: if the tick thread archived a NEWER
-        # snapshot while this (possibly transport-thread) miss was
-        # listing the directory, its cache entry must win — a stale
-        # write-back here would pin an old/None value until the group's
-        # next checkpoint.
-        return self._newest.setdefault(g, snap)
+        # The gen check and the write-back must be ONE atomic step (under
+        # _gen_lock, paired with destroy's pop+bump): a bare
+        # check-then-setdefault leaves a preemption window in which
+        # destroy() completes between the two and the dead listing gets
+        # cached anyway — handing out a deleted path and pinning a stale
+        # Snapshot that a recreated group's save_checkpoint would trip
+        # its ordering assert on.
+        with self._gen_lock:
+            if self._gen.get(g, 0) != gen:
+                # destroy() completed while this miss was listing: the
+                # listing belongs to the dead incarnation.
+                return None
+            # setdefault, not assignment: if the tick thread archived a
+            # NEWER snapshot while this (possibly transport-thread) miss
+            # was listing the directory, its cache entry must win — a
+            # stale write-back here would pin an old/None value until
+            # the group's next checkpoint.
+            return self._newest.setdefault(g, snap)
 
     def list_snapshots(self, g: int) -> List[Snapshot]:
         d = self._gdir(g)
         out = []
-        for name in os.listdir(d):
+        try:
+            names = os.listdir(d)
+        except OSError:
+            # destroy()'s rmtree raced this listing (the _dirs cache said
+            # the dir existed): the group is gone — an empty listing, not
+            # a crash in the snapshot-serving thread.
+            return []
+        for name in names:
             m = _NAME.match(name)
             if m:
                 out.append(Snapshot(os.path.join(d, name),
@@ -188,4 +218,12 @@ class SnapshotArchive:
         shutil.rmtree(self._gdir(g), ignore_errors=True)
         self._pending.pop(g, None)
         self._dirs.discard(g)
-        self._newest.pop(g, None)
+        # Pop and bump under the lock, AFTER the rmtree: a concurrent
+        # last_snapshot miss either wins the lock first (its possibly
+        # pre-rmtree cache entry is popped right here) or enters after
+        # and sees the bumped gen, discarding its dead listing.  A miss
+        # that starts after the bump lists the (empty) new-incarnation
+        # directory — caching that is correct.
+        with self._gen_lock:
+            self._newest.pop(g, None)
+            self._gen[g] = self._gen.get(g, 0) + 1
