@@ -83,6 +83,11 @@ class Administrator:
     def apply(self, index: int, payload: bytes) -> Any:
         assert index == self._last_applied + 1, \
             f"admin apply out of order: {index} after {self._last_applied}"
+        if not payload:
+            # Election-win no-op (machine/spi.py: empty commands are
+            # harmless by contract).
+            self._last_applied = index
+            return None
         cmd = json.loads(payload)
         op = cmd["op"]
         result: Any

@@ -276,6 +276,24 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     fail_at = jnp.where(vote_win[:, None], 0, fail_at)
     fail_streak = jnp.where(vote_win[:, None], 0, fail_streak)
     hb_due = jnp.where(vote_win, now, hb_due)
+    # Raft §8 liveness: a fresh leader appends an OWN-TERM NO-OP entry so
+    # its predecessors' entries become committable immediately — the
+    # commit rule (phase 10, reference Leader.java:256-261) only counts a
+    # quorum at the leader's own term, so without this a cluster with no
+    # new client traffic never surfaces a deposed leader's
+    # committed-at-majority suffix (the reference shares the gap; its
+    # system test masks it with always-on traffic).  Skipped when the
+    # ring is full — such a lane is already acceptance-stalled and drains
+    # through compaction first.  The host stages the no-op durably with
+    # an empty payload (StepInfo.noop_idx/noop_term), and followers adopt
+    # it through ordinary replication; machines see one empty command.
+    noop_ok = vote_win & (log.last - log.base < L)
+    noop_idx = jnp.where(noop_ok, log.last + 1, 0)
+    noop_term = jnp.where(noop_ok, term, 0)
+    log = log.replace(
+        term=ring_write_batch(log.term, (log.last + 1)[:, None],
+                              term[:, None], noop_ok[:, None]),
+        last=log.last + noop_ok.astype(I32))
 
     # ---- 4. AppendEntries requests ----------------------------------------
     # (reference Follower.appendEntries:35-88 — consistency check, conflict
@@ -718,6 +736,7 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         appended_from=app_from, appended_to=app_to, log_tail=log.last,
         commit=commit, leader=leader_id, ready=ready, snap_req=snap_req,
         snap_req_from=snap_from, snap_req_idx=snap_idx_o,
-        snap_req_term=snap_term_o, debug_viol=debug_viol,
+        snap_req_term=snap_term_o, noop_idx=noop_idx, noop_term=noop_term,
+        debug_viol=debug_viol,
     )
     return new_state, outbox, info

@@ -323,6 +323,14 @@ class StepInfo:
     snap_req_from: jax.Array  # [G] int32 — peer to download from
     snap_req_idx: jax.Array   # [G] int32
     snap_req_term: jax.Array  # [G] int32
+    noop_idx: jax.Array       # [G] int32 — index of the own-term NO-OP a fresh
+                              #   leader appended this tick (0 = none; Raft §8
+                              #   liveness — the host stages it with an empty
+                              #   payload so it is durable like any entry)
+    noop_term: jax.Array      # [G] int32 — the no-op's term (the election-win
+                              #   term; carried explicitly so a later-phase
+                              #   term bump in the same tick cannot skew the
+                              #   staged record)
     debug_viol: jax.Array     # [G] int32 — in-kernel invariant violation code
                               #   (0 = ok; codes in step.py DEBUG_CODES).
                               #   Always zeros unless cfg.debug_checks.
@@ -339,6 +347,7 @@ class StepInfo:
             ready=jnp.zeros((G,), jnp.bool_),
             snap_req=jnp.zeros((G,), jnp.bool_),
             snap_req_from=z(), snap_req_idx=z(), snap_req_term=z(),
+            noop_idx=z(), noop_term=z(),
             debug_viol=z(),
         )
 

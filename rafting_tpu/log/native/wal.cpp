@@ -583,13 +583,70 @@ uint64_t wal_export_state(void* h, uint32_t G, uint32_t L,
 // Batched append: n entries across any mix of groups in ONE call, payload
 // bytes concatenated in `payloads` at offsets `offs` (the host runtime
 // stages a whole tick's writes and crosses the ctypes boundary once).
+// Hot path of the durable tier: records are framed IN PLACE into the
+// write buffer (no per-entry body vector; the CRC chains over header and
+// payload without a copy) and the in-memory index exploits the staging
+// order — entries arrive as ascending contiguous runs per group, so after
+// one drop_suffix at a run's head every insert is an O(1) hinted
+// emplace at map end instead of an O(log n) search.
 void wal_append_entries(void* h, uint64_t n, const uint32_t* groups,
                         const uint64_t* idxs, const int64_t* terms,
                         const uint8_t* payloads, const uint64_t* offs,
                         const uint32_t* lens) {
-  for (uint64_t i = 0; i < n; i++)
-    wal_append_entry(h, groups[i], idxs[i], terms[i],
-                     payloads + offs[i], lens[i]);
+  Wal* w = (Wal*)h;
+  uint64_t total = 0;
+  for (uint64_t i = 0; i < n; i++) total += 37u + (uint64_t)lens[i];
+  w->buf.reserve(w->buf.size() + total);
+  uint8_t hdr[25];
+  hdr[0] = kEntry;
+  GroupState* gs = nullptr;
+  uint32_t cur_g = 0;
+  uint64_t prev_idx = 0;
+  bool run_live = false;
+  for (uint64_t i = 0; i < n; i++) {
+    const uint32_t g = groups[i];
+    const uint64_t idx = idxs[i];
+    const uint32_t plen = lens[i];
+    const uint8_t* p = payloads + offs[i];
+    // body header (little-endian, layout matches wal_append_entry)
+    hdr[1] = (uint8_t)g; hdr[2] = (uint8_t)(g >> 8);
+    hdr[3] = (uint8_t)(g >> 16); hdr[4] = (uint8_t)(g >> 24);
+    for (int b = 0; b < 8; b++) hdr[5 + b] = (uint8_t)(idx >> (8 * b));
+    const uint64_t t = (uint64_t)terms[i];
+    for (int b = 0; b < 8; b++) hdr[13 + b] = (uint8_t)(t >> (8 * b));
+    hdr[21] = (uint8_t)plen; hdr[22] = (uint8_t)(plen >> 8);
+    hdr[23] = (uint8_t)(plen >> 16); hdr[24] = (uint8_t)(plen >> 24);
+    const uint32_t crc = crc32(p, plen, crc32(hdr, 25));
+    put_u32(w->buf, kMagic);
+    put_u32(w->buf, 25u + plen);
+    put_u32(w->buf, crc);
+    const uint64_t body_off = w->seg_off + w->buf.size();
+    w->buf.insert(w->buf.end(), hdr, hdr + 25);
+    if (plen) w->buf.insert(w->buf.end(), p, p + plen);
+    // index update (mirrors wal_append_entry/replay semantics)
+    if (gs == nullptr || g != cur_g) {
+      gs = &w->groups[g];
+      cur_g = g;
+      run_live = false;
+    }
+    if (run_live && idx == prev_idx + 1) {
+      gs->entries.emplace_hint(gs->entries.end(), idx,
+                               EntryRef{terms[i], w->seg_id, body_off + 25,
+                                        plen});
+    } else {
+      gs->drop_suffix(idx);
+      gs->entries[idx] = EntryRef{terms[i], w->seg_id, body_off + 25, plen};
+      run_live = true;
+    }
+    gs->tail = (int64_t)idx;
+    prev_idx = idx;
+    if (w->seg_off + w->buf.size() >= w->segment_bytes) {
+      maybe_rotate(*w);
+      gs = nullptr;  // rotation does not move the map, but re-resolve for
+                     // clarity; the payload refs already recorded keep
+                     // their (seg, off) and are unaffected.
+    }
+  }
 }
 
 // Rewrite all live state into a fresh segment and delete older segments —

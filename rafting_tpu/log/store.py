@@ -24,10 +24,12 @@ floor are pruned as the floor advances.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from bisect import bisect_right
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..transport.codec import PayloadRun
 from .wal import WalStore
 
 
@@ -35,26 +37,73 @@ class LogStore:
     def __init__(self, path: str, segment_bytes: int = 64 << 20, *,
                  force_python: bool = False):
         self.wal = WalStore(path, segment_bytes, force_python=force_python)
-        # group -> {index -> payload bytes}; hot mirror of the live window.
-        # Keyed per group so floor/truncate/reset maintenance scans only
-        # that group's window, never the whole node's cache (a flat dict
-        # made set_floor O(total cache) per group — O(G^2) per tick under
-        # dense load).
-        self._cache: Dict[int, Dict[int, bytes]] = {}
+        # group -> ([run starts], [PayloadRun]) sorted by start: the hot
+        # mirror of the live window as contiguous arena runs — the same
+        # currency the wire codec and the staging path speak, so cache
+        # maintenance is O(runs touched) and reads for the replication/
+        # apply windows are buffer slices, never per-entry dict ops (the
+        # per-entry bytes cache was ~15% of the durable tick at 32k).
+        # Keyed per group so floor/truncate/reset maintenance touches only
+        # that group's runs.
+        self._cache: Dict[int, Tuple[List[int], List[PayloadRun]]] = {}
         # last durable (term, ballot) per group, to skip no-op stable writes
         self._stable: Dict[int, tuple] = {}
         self._durable_tail: Dict[int, int] = {}
+
+    # -- the run cache -------------------------------------------------------
+
+    def _add_run(self, g: int, run: PayloadRun) -> None:
+        """Insert a freshly written run (overwrite semantics: any cached
+        entry at >= run.start dies first, mirroring the WAL's replay)."""
+        if not len(run.lens):
+            return   # empty runs have no overwrite effect
+        starts, runs = self._cache.setdefault(g, ([], []))
+        while starts and starts[-1] >= run.start:
+            starts.pop()
+            runs.pop()
+        if runs and runs[-1].end >= run.start:
+            r = runs[-1]
+            keep = run.start - r.start
+            runs[-1] = PayloadRun(r.start, r.buf, r.offs[:keep],
+                                  r.lens[:keep])
+        starts.append(run.start)
+        runs.append(run)
+
+    def _run_at(self, g: int, idx: int) -> Optional[PayloadRun]:
+        ent = self._cache.get(g)
+        if not ent:
+            return None
+        starts, runs = ent
+        i = bisect_right(starts, idx) - 1
+        if i < 0:
+            return None
+        r = runs[i]
+        return r if r.end >= idx else None
+
+    def _backfill(self, g: int, idx: int, payload: bytes) -> None:
+        """Cache a WAL read as a one-entry run WITHOUT the overwrite
+        semantics of _add_run (a backfill of an OLD index must never
+        evict newer cached runs).  Skipped if anything already covers or
+        collides at the insertion point — the WAL stays authoritative."""
+        starts, runs = self._cache.setdefault(g, ([], []))
+        i = bisect_right(starts, idx)
+        if i > 0 and runs[i - 1].end >= idx:
+            return                      # already covered
+        run = PayloadRun(idx, payload, np.zeros(1, np.uint64),
+                         np.asarray([len(payload)], np.uint32))
+        starts.insert(i, idx)
+        runs.insert(i, run)
 
     # -- staging writes (durable after sync()) ------------------------------
 
     def append_entries(self, g: int, start: int, terms: Sequence[int],
                        payloads: Sequence[bytes]) -> None:
         """Write entries [start, start+len) (overwrite semantics)."""
-        gc = self._cache.setdefault(g, {})
+        if not len(payloads):
+            return   # a degenerate empty run must not evict cached suffix
         for k, (t, p) in enumerate(zip(terms, payloads)):
-            idx = start + k
-            self.wal.append_entry(g, idx, int(t), p)
-            gc[idx] = p
+            self.wal.append_entry(g, start + k, int(t), p)
+        self._add_run(g, PayloadRun.from_payloads(start, list(payloads)))
         self._durable_tail[g] = max(self._durable_tail.get(g, 0),
                                     start + len(terms) - 1)
 
@@ -63,28 +112,80 @@ class LogStore:
         """Stage a whole tick's appends across all groups in one engine
         call (native: one ctypes crossing; the batching analog of the
         reference's group-commit WAL flush, RocksLog flushWal after a
-        batch, command/storage/RocksLog.java:87,195).
-
-        Cache maintenance is bulked per same-group RUN (the runtime stages
-        each group's entries contiguously): one dict resolution + one
-        C-speed ``update`` per run instead of per-entry Python — the
-        per-entry loop here was ~15% of the durable tick under dense load.
-        Non-contiguous batches remain correct (runs just get shorter)."""
+        batch, command/storage/RocksLog.java:87,195).  Cache maintenance
+        is bulked per same-group contiguous RUN; non-contiguous batches
+        remain correct (runs just get shorter)."""
         self.wal.append_batch(groups, idxs, terms, payloads)
         n = len(groups)
         start = 0
         while start < n:
             g = int(groups[start])
+            i0 = int(idxs[start])
             end = start + 1
-            while end < n and groups[end] == g:
+            # extend while same group AND contiguous indices
+            while (end < n and groups[end] == g
+                   and int(idxs[end]) == i0 + (end - start)):
                 end += 1
-            run = [int(i) for i in idxs[start:end]]
-            self._cache.setdefault(g, {}).update(
-                zip(run, payloads[start:end]))
-            hi = max(run)
+            self._add_run(g, PayloadRun.from_payloads(
+                i0, list(payloads[start:end])))
+            hi = i0 + (end - start) - 1
             if hi > self._durable_tail.get(g, 0):
                 self._durable_tail[g] = hi
             start = end
+
+    def append_spans(self, spans: Sequence[tuple]) -> None:
+        """The arena fast path (VERDICT r4 #2): stage a whole tick's
+        appends as contiguous spans ``(g, start, piece, lens_u32,
+        terms)`` — pieces are buffer slices whose entries sit
+        back-to-back; ``terms`` is an int64 vector (adoption) or a plain
+        int (own submissions, all at the leader's term).  The global
+        arena's metadata is assembled with vector ops over the span
+        HEADERS (np.repeat / one global cumsum) — per-span Python is
+        three tight loop bodies, per-ENTRY Python is zero — and ONE
+        native call writes everything; the cache records each span as a
+        run sharing slices of the same global offset vector."""
+        n_spans = len(spans)
+        counts = np.empty(n_spans, np.int64)
+        gs_v = np.empty(n_spans, np.int64)
+        starts_v = np.empty(n_spans, np.int64)
+        j = 0
+        for sp in spans:
+            gs_v[j] = sp[0]
+            starts_v[j] = sp[1]
+            counts[j] = len(sp[3])
+            j += 1
+        ends = np.cumsum(counts)
+        total = int(ends[-1])
+        span_pos = ends - counts           # flat start offset of each span
+        g_all = np.repeat(gs_v, counts).astype(np.uint32)
+        i_all = (np.arange(total, dtype=np.int64)
+                 + np.repeat(starts_v - span_pos, counts)).astype(np.uint64)
+        lens_all = np.empty(total, np.uint32)
+        t_all = np.empty(total, np.int64)
+        pos = 0
+        for sp in spans:
+            cnt = len(sp[3])
+            sl = slice(pos, pos + cnt)
+            lens_all[sl] = sp[3]
+            t_all[sl] = sp[4]              # scalar or vector, both C-speed
+            pos += cnt
+        offs_all = np.zeros(total, np.uint64)
+        if total > 1:
+            np.cumsum(lens_all[:-1].astype(np.uint64), out=offs_all[1:])
+        pos = 0
+        dt = self._durable_tail
+        for sp in spans:
+            g, start = sp[0], sp[1]
+            cnt = len(sp[3])
+            offs = offs_all[pos:pos + cnt] - offs_all[pos]
+            self._add_run(g, PayloadRun(start, sp[2], offs, sp[3]))
+            pos += cnt
+            tail_new = start + cnt - 1
+            if tail_new > dt.get(g, 0):
+                dt[g] = tail_new
+        self.wal.append_arena(
+            g_all, i_all, t_all,
+            b"".join(sp[2] for sp in spans), offs_all, lens_all)
 
     def truncate_to(self, g: int, tail: int) -> None:
         """Ensure the durable suffix beyond `tail` dies (conflict/snapshot
@@ -92,10 +193,17 @@ class LogStore:
         if self._durable_tail.get(g, self.wal.tail(g)) > tail:
             self.wal.truncate(g, tail + 1)
             self._durable_tail[g] = tail
-            gc = self._cache.get(g)
-            if gc:
-                for k in [k for k in gc if k > tail]:
-                    del gc[k]
+            ent = self._cache.get(g)
+            if ent:
+                starts, runs = ent
+                while starts and starts[-1] > tail:
+                    starts.pop()
+                    runs.pop()
+                if runs and runs[-1].end > tail:
+                    r = runs[-1]
+                    keep = tail - r.start + 1
+                    runs[-1] = PayloadRun(r.start, r.buf, r.offs[:keep],
+                                          r.lens[:keep])
 
     def put_stable(self, g: int, term: int, ballot: int) -> None:
         if self._stable.get(g) == (term, ballot):
@@ -108,10 +216,21 @@ class LogStore:
         if index <= self.wal.floor(g):
             return
         self.wal.milestone(g, index, term)
-        gc = self._cache.get(g)
-        if gc:
-            for k in [k for k in gc if k <= index]:
-                del gc[k]
+        ent = self._cache.get(g)
+        if ent:
+            starts, runs = ent
+            drop = 0
+            while drop < len(runs) and runs[drop].end <= index:
+                drop += 1
+            if drop:
+                del starts[:drop]
+                del runs[:drop]
+            if runs and runs[0].start <= index:
+                r = runs[0]
+                k = index + 1 - r.start
+                runs[0] = PayloadRun(index + 1, r.buf, r.offs[k:],
+                                     r.lens[k:])
+                starts[0] = index + 1
         self._durable_tail[g] = max(self._durable_tail.get(g, 0), index)
 
     def reset_group(self, g: int) -> None:
@@ -173,13 +292,15 @@ class LogStore:
     # -- reads ---------------------------------------------------------------
 
     def payload(self, g: int, idx: int) -> Optional[bytes]:
-        gc = self._cache.setdefault(g, {})
-        p = gc.get(idx)
-        if p is not None:
-            return p
+        r = self._run_at(g, idx)
+        if r is not None:
+            return r.entry(idx - r.start)
         p = self.wal.entry_payload(g, idx)
         if p is not None:
-            gc[idx] = p
+            # Cache the miss: a laggard catch-up re-reads the same window
+            # every tick until the follower advances — one WAL read per
+            # entry, not one per entry per tick.
+            self._backfill(g, idx, p)
         return p
 
     def payload_batch(self, g: int, start: int, n: int) -> List[bytes]:
@@ -188,22 +309,61 @@ class LogStore:
 
     def payloads_window(self, g: int, start: int, n: int
                         ) -> List[Optional[bytes]]:
-        """Payloads for [start, start+n) with None where absent — one
-        cache-dict resolution for the whole window (the replication pack
-        path calls this once per AE column instead of once per entry).
-        The all-cached common case is a single comprehension; WAL reads
-        only run for the (rare) misses."""
-        gc = self._cache.setdefault(g, {})
-        get = gc.get
-        out: List[Optional[bytes]] = [get(i) for i in range(start, start + n)]
-        if None in out:
-            for k, p in enumerate(out):
-                if p is None:
-                    p = self.wal.entry_payload(g, start + k)
-                    if p is not None:
-                        gc[start + k] = p
-                        out[k] = p
+        """Payloads for [start, start+n) with None where absent — run
+        lookups amortized over the window (the replication pack and apply
+        paths call this once per window instead of once per entry).  WAL
+        reads only run for the (rare) cache misses."""
+        out: List[Optional[bytes]] = [None] * n
+        idx = start
+        while idx < start + n:
+            r = self._run_at(g, idx)
+            if r is None:
+                p = self.wal.entry_payload(g, idx)
+                if p is not None:
+                    self._backfill(g, idx, p)
+                out[idx - start] = p
+                idx += 1
+                continue
+            k = idx - r.start
+            m = min(r.end, start + n - 1) - idx + 1
+            mv = memoryview(r.buf)
+            offs, lens = r.offs, r.lens
+            for j in range(m):
+                a = int(offs[k + j])
+                out[idx - start + j] = bytes(mv[a:a + int(lens[k + j])])
+            idx += m
         return out
+
+    def payload_runs(self, g: int, start: int, n: int):
+        """Zero-copy window read: ``(pieces, lens)`` where pieces are
+        contiguous buffer slices covering entries [start, start+n) in
+        order and lens is the uint32 length vector — the wire pack path
+        consumes this with no per-entry work.  Cache misses fall back to
+        WAL reads (as one-entry pieces); returns None iff an entry is
+        truly absent (caller drops the column, same loss semantics as
+        ever)."""
+        pieces: List = []
+        len_parts: List[np.ndarray] = []
+        idx = start
+        while idx < start + n:
+            r = self._run_at(g, idx)
+            if r is None:
+                p = self.wal.entry_payload(g, idx)
+                if p is None:
+                    return None
+                self._backfill(g, idx, p)
+                pieces.append(p)
+                len_parts.append(np.asarray([len(p)], np.uint32))
+                idx += 1
+                continue
+            k = idx - r.start
+            m = min(r.end, start + n - 1) - idx + 1
+            pieces.append(r.piece(k, m))
+            len_parts.append(r.lens[k:k + m])
+            idx += m
+        lens = (len_parts[0] if len(len_parts) == 1
+                else np.concatenate(len_parts))
+        return pieces, lens
 
     def entry_term(self, g: int, idx: int) -> int:
         return int(self.wal.entry_term(g, idx))

@@ -228,14 +228,27 @@ class _NativeWal:
         lens = np.fromiter((len(p) for p in payloads), np.uint32, n)
         offs = np.zeros(n, np.uint64)
         offs[1:] = np.cumsum(lens[:-1], dtype=np.uint64)
-        blob = b"".join(payloads)
-        g_arr = np.asarray(groups, np.uint32)
-        i_arr = np.asarray(idxs, np.uint64)
-        t_arr = np.asarray(terms, np.int64)
+        self.append_arena(groups, idxs, terms, b"".join(payloads), offs, lens)
+
+    def append_arena(self, groups, idxs, terms, blob: bytes, offs,
+                     lens) -> None:
+        """Arena variant: the caller already holds payload bytes as ONE
+        contiguous blob with per-entry offsets/lengths (the staging path's
+        native currency) — pointers cross ctypes directly, nothing is
+        re-joined or re-measured."""
+        import numpy as np
+        n = len(lens)
+        if n == 0:
+            return
+        g_arr = np.ascontiguousarray(groups, np.uint32)
+        i_arr = np.ascontiguousarray(idxs, np.uint64)
+        t_arr = np.ascontiguousarray(terms, np.int64)
+        o_arr = np.ascontiguousarray(offs, np.uint64)
+        l_arr = np.ascontiguousarray(lens, np.uint32)
         ptr = lambda a: a.ctypes.data_as(ctypes.c_void_p)
         self._lib.wal_append_entries(
             self._h, n, ptr(g_arr), ptr(i_arr), ptr(t_arr), blob,
-            ptr(offs), ptr(lens))
+            ptr(o_arr), ptr(l_arr))
 
 
 _MAGIC = 0x52574131
@@ -572,6 +585,15 @@ class PyWal:
     def append_batch(self, groups, idxs, terms, payloads) -> None:
         for g, i, t, p in zip(groups, idxs, terms, payloads):
             self.append_entry(int(g), int(i), int(t), p)
+
+    def append_arena(self, groups, idxs, terms, blob, offs, lens) -> None:
+        """Arena variant (same contract as the native engine's): slices the
+        blob per entry — the Python engine is the no-compiler fallback, so
+        per-entry cost is acceptable here."""
+        mv = memoryview(blob)
+        for g, i, t, o, ln in zip(groups, idxs, terms, offs, lens):
+            o = int(o)
+            self.append_entry(int(g), int(i), int(t), bytes(mv[o:o + int(ln)]))
 
     def total_bytes(self):
         total = len(self._buf) + self._f.tell()

@@ -34,6 +34,11 @@ class KVMachine:
 
     def apply(self, index: int, payload: bytes) -> Any:
         assert index == self._last_applied + 1
+        if not payload:
+            # Election-win no-op (machine/spi.py: empty commands are
+            # harmless by contract).
+            self._last_applied = index
+            return None
         cmd = json.loads(payload)
         op = cmd.get("op")
         result = None

@@ -23,7 +23,10 @@ class RaftMachine(Protocol):
       order, starting at ``last_applied() + 1``.  It must be atomic: apply
       fully or raise (a raise halts the group's apply frontier; the
       dispatcher retries later — reference RetryCommandException semantics,
-      support/anomaly/RetryCommandException.java:10-25).
+      support/anomaly/RetryCommandException.java:10-25).  Payloads may be
+      EMPTY (``b""``): a freshly elected leader appends one empty no-op
+      entry (Raft §8 liveness, core/step.py phase 3) — machines must
+      treat it as a harmless command (apply it, return anything).
     * :meth:`checkpoint` produces a durable snapshot whose index is at
       least ``must_include`` (may block; called off the apply path).
     * :meth:`recover` atomically replaces state from a checkpoint.
@@ -40,6 +43,13 @@ class RaftMachine(Protocol):
       overriding :meth:`apply` on a base that defines ``apply_batch``
       must override ``apply_batch`` too, or the dispatcher's batch path
       will bypass the override.
+    * :meth:`apply_run` (optional, preferred over ``apply_batch`` when
+      present): the ARENA variant — payload bytes arrive as contiguous
+      buffer pieces plus a uint32 length vector instead of a per-entry
+      list, so a machine that can consume slices (or ignores payloads)
+      pays ZERO per-entry materialization.  Same shorter-prefix failure
+      contract as ``apply_batch``, and the same caution about
+      overriding ``apply``.
     """
 
     def last_applied(self) -> int: ...

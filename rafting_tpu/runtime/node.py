@@ -64,56 +64,112 @@ from ..utils.profiling import TickProfiler
 
 log = logging.getLogger(__name__)
 
+# Shared length vector for election no-op spans (one empty payload);
+# consumers only read it.
+_NOOP_LENS = np.zeros(1, np.uint32)
+
 
 class BatchSubmit:
     """One future for a whole batch of commands (resolves to the list of
-    apply results in submission order).  Amortizes the per-command
-    ``Future`` cost — a ``threading.Condition`` allocation per command was
-    the top client-side cost under dense load.  Completion/failure happen
-    on the tick thread only (the dispatcher's single-writer rule), so no
-    extra locking is needed.  On failure the future raises
-    ``BatchAbortedError`` carrying per-slot outcomes, so an already
-    committed-and-applied prefix is never silently discarded."""
+    apply results in submission order; ``single=True`` — the plain
+    ``submit()`` path — resolves to the lone result itself and fails with
+    the bare error).  Amortizes the per-command ``Future`` cost — a
+    ``threading.Condition`` allocation per command was the top client-side
+    cost under dense load.  Speaks the dispatcher's promise-sink protocol
+    (``_complete``/``_fail``) directly, so a whole accepted batch registers
+    as ONE promise range.  Completion/failure happen on the tick thread
+    only (the dispatcher's single-writer rule), so no extra locking is
+    needed.  On failure the future raises ``BatchAbortedError`` carrying
+    per-slot outcomes, so an already committed-and-applied prefix is never
+    silently discarded."""
 
-    __slots__ = ("future", "results", "completed", "_remaining")
+    __slots__ = ("_future", "results", "completed", "_remaining", "single",
+                 "_err")
 
-    def __init__(self, n: int):
-        self.future: Future = Future()
+    # One shared lock for the lazy-future handoff (creation vs completion
+    # can race across client and tick threads).  Class-level on purpose: a
+    # lock PER batch would reintroduce the per-batch allocation cost the
+    # laziness exists to kill, and the critical sections are a few
+    # dictionary-free statements.
+    _lock = threading.Lock()
+
+    def __init__(self, n: int, single: bool = False, eager: bool = True):
+        """``eager=False`` defers the Future (and its Condition allocation)
+        until someone actually reads ``.future`` — the bulk fan-out path
+        (submit_batch_many) creates ~100k batches per round whose futures
+        are usually never awaited."""
+        self._future: Optional[Future] = Future() if eager else None
         self.results: list = [None] * n
         self.completed: list = [False] * n
         self._remaining = n
+        self.single = single
+        self._err: Optional[Exception] = None
+
+    @property
+    def future(self) -> Future:
+        f = self._future
+        if f is None:
+            with self._lock:
+                f = self._future
+                if f is None:
+                    f = Future()
+                    # Completion state that landed before this publish is
+                    # replayed here; later completions see _future set.
+                    if self._err is not None:
+                        f.set_exception(self._err)
+                    elif self._remaining == 0:
+                        f.set_result(
+                            self.results[0] if self.single else self.results)
+                    self._future = f
+        return f
 
     def _complete(self, k: int, result) -> None:
         self.results[k] = result
         self.completed[k] = True
         self._remaining -= 1
-        if self._remaining == 0 and not self.future.done():
-            self.future.set_result(self.results)
+        if self._remaining == 0:
+            with self._lock:
+                f = self._future
+            if f is not None and not f.done():
+                f.set_result(
+                    self.results[0] if self.single else self.results)
 
     def _fail(self, err: Exception) -> None:
-        if not self.future.done():
-            self.future.set_exception(BatchAbortedError(
-                err, list(self.results), list(self.completed)))
+        wrapped = err if self.single else BatchAbortedError(
+            err, list(self.results), list(self.completed))
+        with self._lock:
+            if self._err is None:
+                self._err = wrapped
+            f = self._future
+        if f is not None and not f.done():
+            f.set_exception(wrapped)
+
+    def _refuse(self, err: Exception) -> None:
+        """Pre-log refusal of the WHOLE batch: nothing was enqueued, so the
+        future carries the bare (marked) refusal — not a BatchAbortedError
+        — matching submit_batch's refusal contract."""
+        with self._lock:
+            if self._err is None:
+                self._err = err
+            f = self._future
+        if f is not None and not f.done():
+            f.set_exception(err)
 
 
-class _BatchSlot:
-    """Future-compatible handle for one command inside a BatchSubmit (the
-    promise map and rejection sweeps treat it exactly like a Future)."""
+class _SubBatch:
+    """One queued client batch: an arena of payload bytes plus its promise
+    sink.  ``taken`` tracks how many entries the device already accepted
+    (a batch can be consumed across ticks); the queue drops it once fully
+    taken.  Building the arena happens on the CLIENT thread (submit /
+    submit_batch), so the tick thread's accept path is pure pointer
+    arithmetic — no per-entry Python ever again."""
 
-    __slots__ = ("batch", "k")
+    __slots__ = ("run", "sink", "taken")
 
-    def __init__(self, batch: BatchSubmit, k: int):
-        self.batch = batch
-        self.k = k
-
-    def done(self) -> bool:
-        return self.batch.future.done()
-
-    def set_result(self, result) -> None:
-        self.batch._complete(self.k, result)
-
-    def set_exception(self, err: Exception) -> None:
-        self.batch._fail(err)
+    def __init__(self, run, sink: BatchSubmit):
+        self.run = run          # codec.PayloadRun (start unused: 0)
+        self.sink = sink
+        self.taken = 0
 
 
 class RaftNode:
@@ -153,7 +209,8 @@ class RaftNode:
         self.archive = SnapshotArchive(os.path.join(data_dir, "snapshots"))
         self.dispatcher = ApplyDispatcher(
             provider, self._payload,
-            payload_window_fn=self.store.payloads_window)
+            payload_window_fn=self.store.payloads_window,
+            payload_runs_fn=getattr(self.store, "payload_runs", None))
         self.maintain = maintain or MaintainAgreement(cfg.n_groups)
         self.template = messages_template(cfg)
         self.acc = InboxAccumulator(cfg, self.template)
@@ -213,11 +270,14 @@ class RaftNode:
         # fresh leader reports not-ready until a majority of peers reply.
         self.h_ready = np.zeros(G, bool)
 
-        # Client submissions: group -> FIFO of (payload, Future), bounded
+        # Client submissions: group -> FIFO of _SubBatch arenas, bounded
         # (reference EventLoop queue capacity + busy threshold,
-        # support/EventLoop.java:16-17, 136-138).
+        # support/EventLoop.java:16-17, 136-138).  _queued_n mirrors each
+        # queue's ENTRY count so the per-tick submit_n inbox lane is one
+        # numpy minimum over all groups instead of a dict walk.
         self._submit_lock = threading.Lock()
-        self._submissions: Dict[int, List[Tuple[bytes, Future]]] = {}
+        self._submissions: Dict[int, deque] = {}
+        self._queued_n = np.zeros(G, np.int32)
         self._queued_total = 0
         self.group_queue_cap = group_queue_cap
         self.total_queue_cap = total_queue_cap
@@ -263,7 +323,15 @@ class RaftNode:
         # rest stay due and drain over the following ticks) — maintenance
         # must never own the tick latency (reference: checkpoints run on a
         # bounded 5-thread pool off the loop, RaftRoutine.java:46-49).
-        self.max_checkpoints_per_tick = 256
+        # Scaled with the group count: compaction can only advance past a
+        # snapshot, so sustained acceptance per group is bounded by
+        # cap * (log_slots - slack) / n_groups entries per tick — a FIXED
+        # cap silently throttled the whole durable tier to ~0.65
+        # entries/tick/group at 100k groups (the r4 "falling with scale"
+        # curve).  The clamp keeps per-tick checkpoint work bounded
+        # (~100-150us each) so maintenance still cannot own tick latency.
+        self.max_checkpoints_per_tick = min(1536, max(256,
+                                                      cfg.n_groups // 32))
         self._ckpt_cursor = 0   # round-robin position for the cap above
         # _gc_phase handoff protocol: the tick thread writes 0->1 (start),
         # the worker writes 1->2 or 1->-1 (done/failed), the tick thread
@@ -353,20 +421,27 @@ class RaftNode:
         drains the queue, otherwise the queue is rejected with NotLeader on
         the next tick (`_persist` rejection sweep); a wrongly-REFUSED
         command just returns a retryable error to the client."""
-        fut: Future = Future()
+        from ..transport.codec import PayloadRun
+
+        sink = BatchSubmit(1, single=True)
+        fut = sink.future
         err = self._refusal(group)
         if err is not None:
             fut.set_exception(err)
             return fut
+        run = PayloadRun(0, payload,
+                         np.zeros(1, np.uint64),
+                         np.asarray([len(payload)], np.uint32))
         with self._submit_lock:
-            q = self._submissions.setdefault(group, [])
-            if (len(q) >= self.group_queue_cap
+            if (int(self._queued_n[group]) >= self.group_queue_cap
                     or self._queued_total
                     >= self.total_queue_cap - self.busy_threshold):
                 fut.set_exception(as_refusal(BusyLoopError(
                     f"group {group}: submission queue full")))
                 return fut
-            q.append((payload, fut))
+            self._submissions.setdefault(group, deque()).append(
+                _SubBatch(run, sink))
+            self._queued_n[group] += 1
             self._queued_total += 1
         return fut
 
@@ -380,6 +455,8 @@ class RaftNode:
         ``completed``/``results`` report exactly which prefix already
         committed and applied — do NOT blindly resubmit the whole batch
         (see the error's docstring for the client contract)."""
+        from ..transport.codec import PayloadRun
+
         batch = BatchSubmit(len(payloads))
         fut = batch.future
         err = self._refusal(group)
@@ -389,18 +466,77 @@ class RaftNode:
         if not payloads:
             fut.set_result([])
             return fut
+        run = PayloadRun.from_payloads(0, payloads)
         with self._submit_lock:
-            q = self._submissions.setdefault(group, [])
-            if (len(q) + len(payloads) > self.group_queue_cap
-                    or self._queued_total + len(payloads)
+            n = len(payloads)
+            if (int(self._queued_n[group]) + n > self.group_queue_cap
+                    or self._queued_total + n
                     > self.total_queue_cap - self.busy_threshold):
                 fut.set_exception(as_refusal(BusyLoopError(
                     f"group {group}: submission queue full")))
                 return fut
-            q.extend((p, _BatchSlot(batch, k))
-                     for k, p in enumerate(payloads))
-            self._queued_total += len(payloads)
+            self._submissions.setdefault(group, deque()).append(
+                _SubBatch(run, batch))
+            self._queued_n[group] += n
+            self._queued_total += n
         return fut
+
+    def submit_batch_many(self, groups, payloads) -> List[BatchSubmit]:
+        """Offer the SAME batch of commands to many groups at once (the
+        vectorized client entry — one arena build and one lock acquisition
+        for the whole fan-out; each group still gets its own BatchSubmit
+        with the full refusal taxonomy).  Returns the per-group handles;
+        read ``handle.future`` to await a group's results — the Future
+        (and its Condition) is allocated lazily on first access, so a
+        fire-and-forget driver feeding 100k groups per round never pays
+        for 100k Futures.  Refusals are recorded on the handle the same
+        lazy way (``handle.future`` raises them on ``result()``)."""
+        from ..transport.codec import PayloadRun
+
+        sinks: List[BatchSubmit] = []
+        n = len(payloads)
+        if n == 0:
+            for _ in groups:
+                s = BatchSubmit(0, eager=False)
+                s._remaining = 0
+                sinks.append(s)
+            return sinks
+        run = PayloadRun.from_payloads(0, payloads)
+        # Refusal prechecks read the tick-refreshed mirrors (same bounded
+        # one-tick race as submit/_refusal — see submit's docstring).
+        role, ready, active = self.h_role, self.h_ready, self.h_active
+        leader, qn = self.h_leader, self._queued_n
+        cap = self.group_queue_cap - n
+        with self._submit_lock:
+            headroom = (self.total_queue_cap - self.busy_threshold
+                        - self._queued_total)
+            for g in groups:
+                g = int(g)
+                sink = BatchSubmit(n, eager=False)
+                sinks.append(sink)
+                if not active[g]:
+                    sink._refuse(as_refusal(
+                        ObsoleteContextError(f"group {g} closed")))
+                    continue
+                if role[g] != LEADER:
+                    hint = int(leader[g])
+                    sink._refuse(as_refusal(NotLeaderError(
+                        g, None if hint == NIL else hint)))
+                    continue
+                if not ready[g]:
+                    sink._refuse(as_refusal(NotReadyError(
+                        f"group {g}: leader lacks a healthy majority")))
+                    continue
+                if qn[g] > cap or headroom < n:
+                    sink._refuse(as_refusal(BusyLoopError(
+                        f"group {g}: submission queue full")))
+                    continue
+                self._submissions.setdefault(g, deque()).append(
+                    _SubBatch(run, sink))
+                qn[g] += n
+                self._queued_total += n
+                headroom -= n
+        return sinks
 
     def _refusal(self, group: int) -> Optional[Exception]:
         """The submission refusal taxonomy, shared by submit/submit_batch
@@ -514,10 +650,10 @@ class RaftNode:
                 self._purge_lanes(purged)
 
         # -- 1. host inbox ---------------------------------------------------
-        submit_n = np.zeros(G, np.int32)
         with self._submit_lock:
-            for g, q in self._submissions.items():
-                submit_n[g] = min(len(q), cfg.max_submit)
+            # One vector op over the entry-count mirror — the dict walk
+            # was O(groups-with-queues) per tick.
+            submit_n = np.minimum(self._queued_n, cfg.max_submit)
         snap_done = np.zeros(G, bool)
         snap_idx = np.zeros(G, np.int32)
         snap_term = np.zeros(G, np.int32)
@@ -640,23 +776,53 @@ class RaftNode:
             self._stable_voted_m[st_changed] = h_voted[st_changed]
 
         # Entries appended/overwritten this tick: stage ALL groups' writes
-        # into one batch, crossing into the WAL engine once (VERDICT r1 #8
-        # — the per-group per-entry Python loop was the scaling wall).
+        # as contiguous arena SPANS — (group, start, buffer-slice, lens,
+        # terms) — crossing into the WAL engine once per tick with numpy
+        # vectors (VERDICT r4 #2: the per-entry Python staging loops here
+        # were the durable tier's scaling wall).  Adoption spans slice the
+        # wire frame's arena directly; own-submission spans slice the
+        # client-built batch arenas.  No per-entry Python on this path.
         wrote = np.nonzero(app_to > 0)[0]
-        bat_g: List[int] = []
-        bat_i: List[int] = []
-        bat_t: List[int] = []
-        bat_p: List[bytes] = []
-        commits: List[Tuple[int, int, int]] = []
-        # Own-submission payloads for every accepting group under ONE lock
-        # (was one acquisition per group per tick).
-        own_by_g: Dict[int, List[bytes]] = {}
+        spans: List[tuple] = []   # (g, start_idx, piece, lens_u32, terms_i64)
+        # Pop every accepting group's accepted prefix under ONE lock;
+        # promise-range registration happens after, outside it.
+        own_by_g: Dict[int, List[tuple]] = {}
         sub_groups = wrote[sub_acc[wrote] > 0]
         if len(sub_groups):
             with self._submit_lock:
                 for g in sub_groups.tolist():
-                    q = self._submissions.get(g, [])
-                    own_by_g[g] = [p for p, _ in q[:int(sub_acc[g])]]
+                    acc_n = int(sub_acc[g])
+                    q = self._submissions.get(g)
+                    cursor = int(sub_start[g])
+                    need = acc_n
+                    taken_spans = own_by_g[g] = []
+                    while need > 0:
+                        # The device never accepts more than submit_n
+                        # (== queue depth at inbox build); an empty queue
+                        # here means the durable log and the promise map
+                        # would silently desynchronize.
+                        assert q, (f"g={g}: device accepted {acc_n} "
+                                   "submissions beyond the queued depth")
+                        b = q[0]
+                        avail = len(b.run) - b.taken
+                        take = min(avail, need)
+                        taken_spans.append((cursor, b, b.taken, take))
+                        b.taken += take
+                        cursor += take
+                        need -= take
+                        if b.taken == len(b.run):
+                            q.popleft()
+                    self._queued_n[g] -= acc_n
+                    self._queued_total -= acc_n
+        # Election-win no-ops (Raft §8, engine phase 3): staged FIRST —
+        # a no-op's index precedes any same-tick submission range, and
+        # WAL replay order must match index order (an append drops the
+        # suffix at >= its index).
+        noop_arr = np.asarray(info.noop_idx)
+        for g in np.nonzero(noop_arr > 0)[0].tolist():
+            spans.append((int(g), int(noop_arr[g]), b"",
+                          _NOOP_LENS, int(np.asarray(info.noop_term)[g])))
+        reg_range = self.dispatcher.register_promise_range
         for g in wrote.tolist():
             lo, hi = int(app_from[g]), int(app_to[g])
             n_sub = int(sub_acc[g])
@@ -665,59 +831,78 @@ class RaftNode:
             # The written range splits into a follower-adoption prefix and
             # an own-submission suffix (in practice a tick has one or the
             # other: adoption needs a non-leader at phase 4, submission a
-            # leader at phase 8).  Staging each range wholesale keeps the
-            # per-entry Python work minimal.
+            # leader at phase 8).
             adopt_hi = min(hi, sub_lo - 1) if n_sub else hi
             gap = False
             if adopt_hi >= lo:
-                # follower adoption: payloads staged as one contiguous run
-                # per (src, group) with the leader's frame; terms from the
-                # same frame's entry vector.  One dict resolution + one
-                # row materialization per GROUP, then plain list indexing
-                # per entry.
+                # Follower adoption: ONE arena slice per group from the
+                # leader's frame (payload run + term vector travel in the
+                # same frame, so their coverage agrees; both are still
+                # bounds-checked).  A partially covered range stages the
+                # covered prefix — the durable prefix stays contiguous and
+                # the leader's resend re-delivers the rest (same loss
+                # semantics as the reference's rejected AE).
                 run = staged_payloads.get((leader_src, g)) \
                     if leader_src >= 0 else None
-                terms = self._staged_terms(inbox_arrays, leader_src, g)
-                for idx in range(lo, adopt_hi + 1):
-                    k = idx - run[0] if run is not None else -1
-                    payload = (run[1][k] if run is not None
-                               and 0 <= k < len(run[1]) else None)
-                    kt = idx - terms[0] if terms is not None else -1
-                    term = (terms[1][kt] if terms is not None
-                            and 0 <= kt < len(terms[1]) else None)
-                    if payload is None or term is None:
-                        # Entry accepted on device but its bytes are not
-                        # locally available (e.g. duplicate-delivery
-                        # edge).  Stop at the gap: the durable prefix
-                        # stays contiguous; resend will re-deliver.
-                        gap = True
-                        break
-                    bat_g.append(g)
-                    bat_i.append(idx)
-                    bat_t.append(term)
-                    bat_p.append(payload)
+                tr = self._staged_terms(inbox_arrays, leader_src, g)
+                end_cov = lo - 1
+                if run is not None and tr is not None \
+                        and lo >= run.start and lo >= tr[0]:
+                    end_cov = min(adopt_hi, run.end,
+                                  tr[0] + len(tr[1]) - 1)
+                if end_cov >= lo:
+                    k = lo - run.start
+                    cnt = end_cov - lo + 1
+                    terms = tr[1][lo - tr[0]:lo - tr[0] + cnt]
+                    spans.append((g, lo, run.piece(k, cnt),
+                                  run.lens[k:k + cnt], terms))
+                gap = end_cov < adopt_hi
             if n_sub and not gap and hi >= sub_lo:
-                # own accepted submissions, all at our term.
-                cnt = hi - sub_lo + 1
-                own = own_by_g.get(g, [])[:cnt]
-                # The device never accepts more than submit_n (== queue
-                # depth at inbox build); a shorter peek means the durable
-                # log and the promise map would silently desynchronize.
-                assert len(own) == cnt, \
-                    f"g={g}: device accepted {cnt} submissions, queue has " \
-                    f"{len(own)}"
-                bat_g.extend([g] * cnt)
-                bat_i.extend(range(sub_lo, hi + 1))
-                bat_t.extend([int(h_term[g])] * cnt)
-                bat_p.extend(own)
-            commits.append((g, sub_lo, n_sub))
-        if bat_g:
-            self.store.append_batch(bat_g, bat_i, bat_t, bat_p)
-            np.maximum.at(self._durable_tail_m,
-                          np.asarray(bat_g, np.int64),
-                          np.asarray(bat_i, np.int64))
+                # Own accepted submissions, all at our term: slice the
+                # client-built arenas; register each span as ONE promise
+                # range (the per-entry Future registration was ~10% of
+                # the durable tick).
+                term_g = int(h_term[g])
+                for start_idx, b, k0, take in own_by_g.get(g, ()):
+                    reg_range(g, start_idx, take, b.sink, k0)
+                    spans.append((g, start_idx, b.run.piece(k0, take),
+                                  b.run.lens[k0:k0 + take], term_g))
+            elif n_sub:
+                # Adoption gap ahead of the submission range (possible
+                # only in the adopt-then-elect-then-accept corner): the
+                # entries are accepted on device, so promises must still
+                # register; staging is skipped to keep the durable prefix
+                # contiguous (resend repairs, then truncation-mirror
+                # reconciles).
+                for start_idx, b, k0, take in own_by_g.get(g, ()):
+                    reg_range(g, start_idx, take, b.sink, k0)
+        if spans:
+            append_spans = getattr(self.store, "append_spans", None)
+            if append_spans is not None:
+                append_spans(spans)
+            else:
+                # LogStoreSPI compat: a store without the arena fast path
+                # gets per-entry materialized lists (the old contract).
+                bat_g: List[int] = []
+                bat_i: List[int] = []
+                bat_t: List[int] = []
+                bat_p: List[bytes] = []
+                for g, start_idx, piece, lens, terms in spans:
+                    mv = memoryview(piece)
+                    off = 0
+                    scalar_term = isinstance(terms, int)
+                    for k, ln in enumerate(lens.tolist()):
+                        bat_g.append(g)
+                        bat_i.append(start_idx + k)
+                        bat_t.append(terms if scalar_term else int(terms[k]))
+                        bat_p.append(bytes(mv[off:off + ln]))
+                        off += ln
+                self.store.append_batch(bat_g, bat_i, bat_t, bat_p)
+            for g, start_idx, piece, lens, _terms in spans:
+                tail_new = start_idx + len(lens) - 1
+                if tail_new > self._durable_tail_m[g]:
+                    self._durable_tail_m[g] = tail_new
             any_write = True
-        self._commit_submissions_batch(commits)
 
         # Truncations: durable tail must not exceed the device tail.
         # Change-detected via the durable-tail mirror (shrinks happen only
@@ -750,38 +935,25 @@ class RaftNode:
         for g in rejected.tolist():
             self._reject_submissions(int(g))
 
-    def _commit_submissions_batch(self, commits) -> None:
-        """Register promises for accepted commands and drop them from their
-        queues — ONE lock acquisition for the whole tick (reference:
-        promise map keyed by EntryKey, context/RaftContext.java:223-237)."""
-        taken_all = []
-        with self._submit_lock:
-            for g, start_idx, n in commits:
-                if n == 0:
-                    continue
-                q = self._submissions.get(g, [])
-                taken, self._submissions[g] = q[:n], q[n:]
-                self._queued_total -= len(taken)
-                taken_all.append((g, start_idx, taken))
-        reg = self.dispatcher.register_promise
-        for g, start_idx, taken in taken_all:
-            for k, (_, fut) in enumerate(taken):
-                reg(g, start_idx + k, fut)
-
     def _reject_submissions(self, g: int,
                             exc: Optional[Exception] = None) -> None:
         """Fail every QUEUED-but-never-device-accepted submission.  These
         provably never entered the log, so the error is a marked refusal
         (retry-safe) — unlike dispatcher.abort_promises, which covers
-        commands already accepted into the log."""
+        commands already accepted into the log.  A batch whose prefix was
+        already accepted fails with the refusal as cause; its
+        BatchAbortedError reports exactly which slots completed (the
+        accepted prefix's promise range stays registered — identical to
+        the old per-slot behavior)."""
         with self._submit_lock:
-            q = self._submissions.get(g, [])
-            self._submissions[g] = []
-            self._queued_total -= len(q)
+            q = self._submissions.pop(g, None)
+            if not q:
+                return
+            self._queued_total -= int(self._queued_n[g])
+            self._queued_n[g] = 0
         err = as_refusal(exc or NotLeaderError(g, self.leader_hint(g)))
-        for payload, fut in q:
-            if not fut.done():
-                fut.set_exception(err)
+        for b in q:
+            b.sink._fail(err)
 
     def _purge_lanes(self, lanes: List[int]) -> None:
         """Wipe destroyed lanes end to end: durable WAL state, machine,
@@ -842,9 +1014,10 @@ class RaftNode:
 
     @staticmethod
     def _staged_terms(arrays, src: int, g: int):
-        """Entry-term run (start_index, [terms]) of the AppendEntries frame
-        the engine just accepted for group ``g`` (host-side; no device
-        read).  None when no valid frame is staged."""
+        """Entry-term run (start_index, term_vector) of the AppendEntries
+        frame the engine just accepted for group ``g`` (host-side; no
+        device read; the vector is a numpy slice, not a per-entry list).
+        None when no valid frame is staged."""
         if src < 0 or not arrays:
             return None
         if not arrays["ae_valid"][src, g]:
@@ -853,7 +1026,7 @@ class RaftNode:
         if n <= 0:
             return None
         start = int(arrays["ae_prev_idx"][src, g]) + 1
-        return start, arrays["ae_ents"][src, g, :n].tolist()
+        return start, arrays["ae_ents"][src, g, :n]
 
     def _payload(self, g: int, idx: int) -> Optional[bytes]:
         return self.store.payload(g, idx)
@@ -869,7 +1042,8 @@ class RaftNode:
                 continue
             fields = {name: arr[p] for name, arr in fields_all.items()}
             packed = pack_slice(self.node_id, fields, self._payload,
-                                self.store.payloads_window)
+                                self.store.payloads_window,
+                                getattr(self.store, "payload_runs", None))
             if packed is not None:
                 self.transport.send_slice(p, packed)
 

@@ -44,7 +44,8 @@ class LocalCluster:
                  seed: int = 0,
                  maintain_factory: Optional[Callable[[], object]] = None,
                  store_factory: Optional[Callable[[int], object]] = None,
-                 serializer_factory: Optional[Callable[[], object]] = None):
+                 serializer_factory: Optional[Callable[[], object]] = None,
+                 transport: str = "loopback"):
         """``provider_factory(node_id)`` returns a MachineProvider; defaults
         to FileMachine per group under ``root/node<i>/machines`` (the
         reference's file-append oracle, cluster/cmd/FileMachine.java).
@@ -54,11 +55,18 @@ class LocalCluster:
         ``store_factory(node_id)`` builds a LogStoreSPI product per node
         (log/spi.py; default: the durable WAL under the node's data dir).
         ``serializer_factory()`` builds a per-node CmdSerializer
-        (api/serial.py; default JSON)."""
+        (api/serial.py; default JSON).
+        ``transport``: ``"loopback"`` (in-process, default) or ``"tcp"`` —
+        real localhost sockets per node, so the framing / sender-queue /
+        reader-thread / accumulator plane is exercised under the same
+        manual-tick control (the reference's system test runs real TCP,
+        test/resources/raft1.xml:3-7)."""
         self.cfg = cfg
         self.root = root
         self.seed = seed
+        self.transport = transport
         self.net = LoopbackNetwork(cfg.n_peers)
+        self._ports = free_ports(cfg.n_peers) if transport == "tcp" else None
         self.provider_factory = provider_factory or (
             lambda i: FileMachineProvider(
                 os.path.join(root, f"node{i}", "machines")))
@@ -73,6 +81,16 @@ class LocalCluster:
 
     def _factory(self, node_id: int):
         def build(node, on_slice, snapshot_provider):
+            if self.transport == "tcp":
+                from ..transport.tcp import TcpTransport
+                peers = {i: ("127.0.0.1", p)
+                         for i, p in enumerate(self._ports)}
+                return TcpTransport(node_id, peers, self.cfg,
+                                    node.template, on_slice,
+                                    snapshot_provider,
+                                    submit_handler=node.submit,
+                                    result_encoder=node.serializer
+                                    .encode_result)
             return LoopbackTransport(self.net, node_id, self.cfg,
                                      node.template, on_slice,
                                      snapshot_provider,
@@ -185,6 +203,18 @@ class LocalCluster:
             return []
         with open(path) as f:
             return f.readlines()
+
+    def command_lines(self, node: int, group: int) -> List[str]:
+        """machine_lines MINUS election no-ops (empty payloads — Raft §8,
+        core/step.py phase 3): what client commands actually applied, for
+        tests that assert content without depending on how many elections
+        the run happened to need."""
+        return [l for l in self.machine_lines(node, group)
+                if l.split(":", 1)[1].strip()]
+
+    def command_payloads(self, node: int, group: int) -> List[str]:
+        return [l.split(":", 1)[1].strip()
+                for l in self.command_lines(node, group)]
 
     def assert_file_parity(self, group: int, require_progress: bool = True
                            ) -> None:

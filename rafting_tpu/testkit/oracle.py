@@ -144,6 +144,7 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         "ready": zb(G),
         "snap_req": zb(G), "snap_req_from": zi(G), "snap_req_idx": zi(G),
         "snap_req_term": zi(G),
+        "noop_idx": zi(G), "noop_term": zi(G),
     }
 
     for g in range(G):
@@ -248,6 +249,14 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
             fail_at[g] = 0
             fail_streak[g] = 0
             hb_due[g] = now
+            # Raft §8 no-op on election win (mirrors kernel phase 3):
+            # appended AFTER the replication matrix reset, so
+            # next/send point exactly at the no-op.
+            if log.last - log.base < L:
+                info["noop_idx"][g] = log.last + 1
+                info["noop_term"][g] = term[g]
+                log.ring[(log.last + 1) % L] = term[g]
+                log.last += 1
 
         # ---- 4. AppendEntries requests ------------------------------------
         # (reference Follower.appendEntries:35-88.)

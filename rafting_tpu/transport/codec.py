@@ -19,14 +19,17 @@ Types:
     SNAP_REQ  — snapshot fetch request: (group, index, term)
                 (reference WaitSnapEvent, transport/event/WaitSnapEvent.java:8-38)
     SNAP_HDR  — snapshot response header: (group, index, term, ok, total_len)
-                (reference TransSnapEvent, transport/event/TransSnapEvent.java:8-64)
-    SNAP_CHUNK— one chunk of snapshot bytes; `total_len` bytes follow the
-                header across N chunks, written to disk incrementally on
-                the receiving side.  Chunking is what frees snapshot bulk
-                from the 64MB MAX_BODY frame cap — the reference achieves
-                the same by streaming the file raw outside its codec
-                (DefaultFileRegion sendfile, transport/EventBus.java:98-111,
-                "transparent mode" in EventCodec.java:282-290).
+                (reference TransSnapEvent, transport/event/TransSnapEvent.java:8-64).
+                After an ok header the stream switches to TRANSPARENT
+                mode: exactly `total_len` RAW file bytes follow, outside
+                the frame codec — served zero-copy via sendfile and
+                written to disk incrementally on the receiving side.
+                This matches the reference byte-for-byte in spirit
+                (DefaultFileRegion sendfile, transport/EventBus.java:98-111;
+                "transparent mode", EventCodec.java:282-290): the CRC
+                covers the header only, the bulk pays no per-chunk
+                framing or checksum, and snapshot size is unbounded by
+                MAX_BODY.
 """
 
 from __future__ import annotations
@@ -38,13 +41,68 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 MAGIC = 0x54505552  # "RUPT"
-(HELLO, MSGS, SNAP_REQ, SNAP_HDR, FWD_REQ, FWD_RESP,
- SNAP_CHUNK) = 1, 2, 3, 4, 5, 6, 7
+HELLO, MSGS, SNAP_REQ, SNAP_HDR, FWD_REQ, FWD_RESP = 1, 2, 3, 4, 5, 6
 
 MAX_BODY = 64 << 20  # 64 MB cap, matching the reference (EventCodec.java:26)
-SNAP_CHUNK_BYTES = 1 << 20  # snapshot streaming chunk size
 
 _HDR = struct.Struct("<IBII")
+
+
+class PayloadRun:
+    """A contiguous run of entry payloads for ONE group, referencing a
+    shared arena buffer: ``offs[k]``/``lens[k]`` locate entry
+    ``start + k``'s bytes inside ``buf``.  The universal payload currency
+    of the host tier (wire unpack -> adoption staging -> WAL -> cache ->
+    wire pack): per-entry bytes objects are materialized only at the few
+    consumers that truly need them (state-machine apply, SPI fallbacks).
+    Entries are back-to-back in ``buf`` (offs strictly cumulative), so any
+    sub-range is itself one contiguous slice — what lets the staging and
+    pack paths work per-RUN instead of per-entry."""
+
+    __slots__ = ("start", "buf", "offs", "lens", "end")
+
+    def __init__(self, start: int, buf, offs: np.ndarray, lens: np.ndarray):
+        self.start = start          # log index of entry 0
+        self.buf = buf              # bytes-like arena
+        self.offs = offs            # uint64 [n] absolute offsets into buf
+        self.lens = lens            # uint32 [n]
+        # Last covered log index, inclusive — precomputed: the run cache's
+        # lookup path reads it millions of times per second.
+        self.end = start + len(lens) - 1
+
+    def __len__(self) -> int:
+        return len(self.lens)
+
+    def piece(self, k0: int, n: int):
+        """The single contiguous buffer slice holding entries
+        [start+k0, start+k0+n) — valid because entries are back-to-back."""
+        a = int(self.offs[k0])
+        b = int(self.offs[k0 + n - 1]) + int(self.lens[k0 + n - 1])
+        return memoryview(self.buf)[a:b]
+
+    def entry(self, k: int) -> bytes:
+        a = int(self.offs[k])
+        return bytes(memoryview(self.buf)[a:a + int(self.lens[k])])
+
+    def materialize(self, k0: int = 0, n: int = -1) -> List[bytes]:
+        """Per-entry bytes for [k0, k0+n) (n=-1: to the end)."""
+        if n < 0:
+            n = len(self.lens) - k0
+        mv = memoryview(self.buf)
+        offs, lens = self.offs, self.lens
+        return [bytes(mv[int(offs[k]):int(offs[k]) + int(lens[k])])
+                for k in range(k0, k0 + n)]
+
+    @classmethod
+    def from_payloads(cls, start: int, payloads) -> "PayloadRun":
+        """Build an arena run from a list of bytes (client submission
+        path): one join + two vector ops, no per-entry records."""
+        n = len(payloads)
+        lens = np.fromiter(map(len, payloads), np.uint32, n)
+        offs = np.zeros(n, np.uint64)
+        if n > 1:
+            np.cumsum(lens[:-1], dtype=np.uint64, out=offs[1:])
+        return cls(start, b"".join(payloads), offs, lens)
 
 # Message kinds -> (valid flag field, data fields).  Field order is the wire
 # order; dtypes/shapes come from the Messages template at pack/unpack time.
@@ -94,6 +152,28 @@ class FrameReader:
             del self._buf[:_HDR.size + blen]
             out.append((ftype, body))
         return out
+
+
+
+def peek_frame(buf) -> Optional[Tuple[int, bytes, int]]:
+    """Decode exactly ONE frame from the head of ``buf``: returns
+    (ftype, body, bytes_consumed), or None if the frame is still
+    incomplete.  For streams that switch to transparent (raw) mode after
+    a known frame — the snapshot channel after SNAP_HDR — where a greedy
+    FrameReader would misparse the raw bytes that rode along in the same
+    recv (the reference decoder makes the same one-frame-then-raw switch,
+    EventCodec.java:282-290)."""
+    if len(buf) < _HDR.size:
+        return None
+    magic, ftype, blen, crc = _HDR.unpack_from(buf, 0)
+    if magic != MAGIC or blen > MAX_BODY:
+        raise IOError(f"bad frame header (magic={magic:#x})")
+    if len(buf) < _HDR.size + blen:
+        return None
+    body = bytes(buf[_HDR.size:_HDR.size + blen])
+    if zlib.crc32(body) != crc:
+        raise IOError("frame CRC mismatch")
+    return ftype, body, _HDR.size + blen
 
 
 def _schema_tag() -> int:
@@ -203,14 +283,13 @@ def unpack_snap_hdr(body: bytes) -> Tuple[int, int, int, bool, int]:
     return group, index, term, bool(ok), total_len
 
 
-def pack_snap_chunk(data: bytes) -> bytes:
-    return frame(SNAP_CHUNK, data)
 
 
 def pack_slice(src: int, fields: Dict[str, np.ndarray],
                payload_fn: Optional[Callable[[int, int], Optional[bytes]]],
                payload_window_fn: Optional[Callable[[int, int, int], list]]
-               = None) -> Optional[bytes]:
+               = None,
+               payload_runs_fn: Optional[Callable] = None) -> Optional[bytes]:
     """Pack one destination's tick slice into a MSGS frame body.
 
     ``fields`` maps Messages field name -> numpy array of shape [G] or
@@ -218,8 +297,11 @@ def pack_slice(src: int, fields: Dict[str, np.ndarray],
     supplies AppendEntries command payloads (LogStore.payload);
     ``payload_window_fn(g, start, n) -> [bytes|None]`` is the batched
     variant (LogStore.payloads_window) used when provided — one call per
-    column instead of one per entry.  Returns None when the slice is empty
-    (nothing valid for this peer).
+    column instead of one per entry.  ``payload_runs_fn(g, start, n) ->
+    (pieces, lens) | None`` is the zero-copy variant (LogStore.
+    payload_runs): contiguous buffer slices + a uint32 length vector, no
+    per-entry Python at all — preferred when available.  Returns None when
+    the slice is empty (nothing valid for this peer).
     """
     if payload_window_fn is None:
         # One resolution path: adapt the per-entry fetcher so the packing
@@ -245,21 +327,33 @@ def pack_slice(src: int, fields: Dict[str, np.ndarray],
             # resend/timeout path already recovers.  Shipping a substitute
             # empty command would silently diverge replica state.
             # Blob layout: one u32 length VECTOR for all kept entries, then
-            # the payload bytes concatenated — two bulk ops instead of a
-            # struct.pack per entry (the pack path is on the per-tick
-            # critical section of every node).
+            # the payload bytes concatenated — per-COLUMN bulk ops (run
+            # slices when the store exposes runs, else a bytes window),
+            # never a struct.pack per entry (the pack path is on the
+            # per-tick critical section of every node).
             prevs = fields["ae_prev_idx"][cols]
             ns = fields["ae_n"][cols]
-            keep, blobs = [], []
+            keep, pieces, len_parts = [], [], []
             for g, prev, n in zip(cols.tolist(), prevs.tolist(), ns.tolist()):
+                if n and payload_runs_fn is not None:
+                    run = payload_runs_fn(int(g), prev + 1, n)
+                    if run is None:
+                        continue
+                    keep.append(g)
+                    pieces.extend(run[0])
+                    len_parts.append(np.asarray(run[1], np.uint32))
+                    continue
                 win = payload_window_fn(int(g), prev + 1, n) if n else []
                 if any(p is None for p in win):
                     continue
                 keep.append(g)
-                blobs.extend(win)
+                pieces.extend(win)
+                len_parts.append(np.fromiter(map(len, win), np.uint32,
+                                             len(win)))
             cols = np.asarray(keep, np.uint32)
-            lens = np.fromiter(map(len, blobs), np.uint32, len(blobs))
-            blob_section = lens.tobytes() + b"".join(blobs)
+            lens = (np.concatenate(len_parts) if len_parts
+                    else np.zeros(0, np.uint32))
+            blob_section = lens.tobytes() + b"".join(pieces)
         n_total += len(cols)
         parts.append(struct.pack("<BI", KIND_IDS[kind], len(cols)))
         if len(cols) == 0:
@@ -277,16 +371,18 @@ def pack_slice(src: int, fields: Dict[str, np.ndarray],
 def unpack_slice(body: bytes, template: Dict[str, Tuple[np.dtype, tuple]],
                  n_groups: Optional[int] = None
                  ) -> Tuple[int, Dict[str, Tuple[np.ndarray, np.ndarray]],
-                            Dict[int, Tuple[int, List[bytes]]]]:
+                            Dict[int, "PayloadRun"]]:
     """Unpack a MSGS body.
 
     ``template`` maps field name -> (dtype, per-group trailing shape), e.g.
     ae_ents -> (int32, (B,)).  Returns (src, {field: (cols, values)},
-    {group: (start_index, [payloads])}) — payloads as one contiguous RUN
-    per group (an AE column is always a contiguous index range), so the
-    adoption path does one dict lookup per group instead of one per entry.
-    ``n_groups`` bounds-checks column ids so a corrupt or shape-mismatched
-    frame can't scatter out of range.
+    {group: PayloadRun}) — payloads as one contiguous arena RUN per group
+    (an AE column is always a contiguous index range) referencing the
+    frame body directly: offsets + lengths, ZERO per-entry bytes objects.
+    The adoption path slices the run's numpy vectors; per-entry bytes are
+    materialized only where a consumer truly needs them (PayloadRun.
+    materialize).  ``n_groups`` bounds-checks column ids so a corrupt or
+    shape-mismatched frame can't scatter out of range.
     """
     end = len(body)
 
@@ -302,7 +398,7 @@ def unpack_slice(body: bytes, template: Dict[str, Tuple[np.dtype, tuple]],
     src, n_kinds = struct.unpack_from("<IB", body, 0)
     off = struct.calcsize("<IB")
     out: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
-    payloads: Dict[int, Tuple[int, List[bytes]]] = {}
+    payloads: Dict[int, PayloadRun] = {}
     for _ in range(n_kinds):
         need(struct.calcsize("<BI"), off)
         kid, n_cols = struct.unpack_from("<BI", body, off)
@@ -335,16 +431,18 @@ def unpack_slice(body: bytes, template: Dict[str, Tuple[np.dtype, tuple]],
             need(4 * total, off)
             lens = np.frombuffer(body, np.uint32, total, off)
             off += 4 * total
-            ends = np.cumsum(lens, dtype=np.int64)
+            ends = np.cumsum(lens, dtype=np.uint64)
             need(int(ends[-1]) if total else 0, off)
-            starts = ends - lens
+            starts = (ends - lens) + np.uint64(off)
             k = 0
             for g, prev, n in zip(cols.tolist(), prevs.tolist(), ns.tolist()):
                 n = int(n)
                 if n:
-                    payloads[int(g)] = (int(prev) + 1, [
-                        body[off + starts[k + j]:off + ends[k + j]]
-                        for j in range(n)])
+                    # One run per group: numpy slices into the shared body
+                    # buffer — no per-entry bytes objects on the unpack
+                    # path (they were ~5% of the durable tick at 32k).
+                    payloads[int(g)] = PayloadRun(
+                        int(prev) + 1, body, starts[k:k + n], lens[k:k + n])
                     k += n
             off += int(ends[-1]) if total else 0
     return src, out, payloads

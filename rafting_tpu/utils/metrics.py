@@ -37,6 +37,14 @@ class Histogram:
         if v > self.max:
             self.max = v
 
+    def reset(self) -> None:
+        """Zero the histogram in place (e.g. a benchmark separating its
+        measure phase from warmup/compile ticks)."""
+        self.counts = [0] * len(self.counts)
+        self.total = 0.0
+        self.n = 0
+        self.max = 0.0
+
     def quantile(self, q: float) -> float:
         """Upper bucket bound at quantile q (conservative estimate)."""
         if self.n == 0:

@@ -144,6 +144,52 @@ def test_leader_failover_and_heal():
         assert c.log_terms(n, 0, lo, hi) == ref
 
 
+def test_new_leader_commits_predecessor_entries_without_traffic():
+    """Raft §8 liveness (the election-win no-op, step.py phase 3): after
+    a leader dies, the NEW leader must surface the predecessor's
+    replicated-at-majority entries WITHOUT any new client traffic.  The
+    commit rule only counts own-term entries (Leader.java:256-261), so
+    absent the no-op the new leader's commit would freeze at whatever it
+    personally saw committed — observed live as kill/restart convergence
+    stalls under a traffic-free drain."""
+    c = DeviceCluster(small_cfg(n_groups=8), seed=11)
+    leaders = wait_for_leaders(c)
+    old = int(leaders[0])
+    for _ in range(12):
+        c.tick(submit_n=2)
+    commit_before = np.asarray(c.states.commit).max(axis=0).copy()
+    c.isolate(old)
+    # NO further submissions, ever.  The property: on every group, the
+    # new leader's ENTIRE log — the inherited suffix it holds (leader
+    # completeness guarantees at least the committed prefix, commonly
+    # more) plus its own no-op — must fully commit.  Without the no-op
+    # the inherited entries beyond commit_before can never commit, since
+    # the commit rule counts only own-term entries.
+    others = [n for n in range(3) if n != old]
+    for _ in range(200):
+        c.tick()
+        role = np.asarray(c.states.role)
+        commit = np.asarray(c.states.commit)
+        tails = np.asarray(c.states.log.last)
+        done = True
+        for g in range(c.cfg.n_groups):
+            lead = [n for n in others if role[n, g] == LEADER]
+            if len(lead) != 1 or commit[lead[0], g] < tails[lead[0], g]:
+                done = False
+                break
+        if done:
+            break
+    else:
+        raise AssertionError(
+            "new leaders never committed their full inherited log + "
+            f"no-op without traffic: commit={commit[others].max(axis=0)} "
+            f"tails={tails[others].max(axis=0)}")
+    # And the no-op made commit strictly ADVANCE past what the old
+    # leadership had already committed (the inherited suffix surfaced).
+    assert (np.asarray(c.states.commit)[others].max(axis=0)
+            >= commit_before).all()
+
+
 def test_election_safety_under_chaos():
     """Randomized partitions every few ticks; election safety + log matching
     must hold throughout (the fuzzable analog of the reference's manual

@@ -32,16 +32,19 @@ def test_elect_submit_apply_parity(cluster):
     lead = c.wait_leader(0)
     # Submit through the leader; future completes with the apply result.
     res = c.submit_via_leader(0, b"hello-0")
-    assert res == 1  # FileMachine.apply returns the index
+    # FileMachine.apply returns the index; the election no-op (Raft §8,
+    # step.py phase 3) occupies index 1, so the first command applies
+    # right after it — equal to the machine's line count at that point.
+    assert res == len(c.machine_lines(c.leader_of(0), 0))
     for k in range(1, 6):
         c.submit_via_leader(0, f"cmd-{k}".encode())
     c.tick(10)  # drain so followers apply too
     c.assert_file_parity(0)
-    # All three nodes applied all 6 entries.
+    # All three nodes applied all 6 commands (no-ops excluded).
     for i in c.nodes:
-        lines = c.machine_lines(i, 0)
-        assert len(lines) == 6
-        assert lines[0] == "1:hello-0\n"
+        cmds = c.command_payloads(i, 0)
+        assert len(cmds) == 6
+        assert cmds[0] == "hello-0"
 
 
 def test_not_leader_rejection(cluster):
@@ -66,11 +69,10 @@ def test_leader_kill_failover_and_restart(cluster):
     # Restart the crashed node: it must rejoin from its WAL and catch up.
     c.restart_node(lead)
     c.tick_until(
-        lambda: len(c.machine_lines(lead, 0)) == 8, 600,
+        lambda: len(c.command_lines(lead, 0)) == 8, 600,
         "restarted node catch-up")
     c.assert_file_parity(0)
-    lines = c.machine_lines(lead, 0)
-    assert [l.split(":", 1)[1].strip() for l in lines] == \
+    assert c.command_payloads(lead, 0) == \
         [f"before-{k}" for k in range(4)] + [f"after-{k}" for k in range(4)]
 
 
@@ -84,7 +86,7 @@ def test_multi_group_independence(cluster):
     for g in range(CFG.n_groups):
         c.assert_file_parity(g)
         lead = c.leader_of(g)
-        assert c.machine_lines(lead, g) == [f"1:g{g}-x\n"]
+        assert c.command_payloads(lead, g) == [f"g{g}-x"]
 
 
 def test_snapshot_install_catches_up_lagging_follower(tmp_path):
@@ -141,9 +143,11 @@ def test_wal_survives_full_cluster_restart(tmp_path):
         c2.wait_leader(0)
         c2.tick(20)
         c2.assert_file_parity(0)
-        # Logs recovered: a new submission lands at index 6.
+        # Logs recovered: the new submission applies as one more line
+        # (index = line count incl. the elections' no-ops).
         res = c2.submit_via_leader(0, b"persist-5")
-        assert res == 6
+        assert res == len(c2.machine_lines(c2.leader_of(0), 0))
+        assert c2.command_payloads(c2.leader_of(0), 0)[-1] == "persist-5"
     finally:
         c2.close()
 

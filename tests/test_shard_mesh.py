@@ -88,6 +88,52 @@ def test_sharded_matches_unsharded_bitexact():
     assert (np.asarray(sh_states.commit).max(axis=0) > 0).all()
 
 
+def test_sharded_bench_shape_5peer_L256():
+    """The tuned bench shape on a sharded mesh (VERDICT r4 #4): 5 peers
+    and L=256 — config-4's peer count with bench_runtime's ring — with
+    the node axis replicated (5 does not divide the device count; the
+    group axis carries the parallelism, exactly the single-chip scaling
+    story) and the group axis split 8 ways.  Bit-exact parity with the
+    unsharded run plus cluster health."""
+    cfg = EngineConfig(n_groups=512, n_peers=5, log_slots=256, batch=32,
+                       max_submit=32, election_ticks=10, heartbeat_ticks=3,
+                       rpc_timeout_ticks=8)
+    s0, m0, i0, conn0, sub0 = _stacked_cluster(cfg)
+    ref_states, _, _ = run_cluster_ticks(cfg, 48, s0, m0, i0, conn0, sub0)
+
+    s1, m1, i1, conn1, sub1 = _stacked_cluster(cfg)
+    mesh = _mesh(1, 8)
+    s1, m1, i1, conn1, sub1 = shard_cluster(mesh, cfg, s1, m1, i1,
+                                            conn1, sub1)
+    sh_states, _, _ = run_cluster_ticks(cfg, 48, s1, m1, i1, conn1, sub1)
+
+    assert np.array_equal(np.asarray(ref_states.commit),
+                          np.asarray(sh_states.commit))
+    assert np.array_equal(np.asarray(ref_states.term),
+                          np.asarray(sh_states.term))
+    roles = np.asarray(sh_states.role)
+    assert ((roles == LEADER).sum(axis=0) == 1).all()
+    assert (np.asarray(sh_states.commit).max(axis=0) > 0).all()
+
+
+def test_sharded_scale_32k_groups():
+    """The dryrun's new scale point (G=32k over a 4x2 mesh, VERDICT r4
+    #4) under pytest, so the node-axis all-to-all is exercised at a
+    realistic group extent in the suite, not only in the driver artifact.
+    Health-checked (not parity — a second unsharded 32k run would double
+    an already long test)."""
+    cfg = EngineConfig(n_groups=32_768, n_peers=4, log_slots=32, batch=4,
+                       max_submit=4, election_ticks=10, heartbeat_ticks=3)
+    s, m, i, conn, sub = _stacked_cluster(cfg)
+    mesh = _mesh(4, 2)
+    s, m, i, conn, sub = shard_cluster(mesh, cfg, s, m, i, conn, sub)
+    states, _, _ = run_cluster_ticks(cfg, 64, s, m, i, conn, sub)
+    roles = np.asarray(states.role)
+    assert ((roles == LEADER).sum(axis=0) == 1).all(), "one leader per group"
+    commit = np.asarray(states.commit)
+    assert (commit.max(axis=0) > 0).all(), "every group commits at 32k"
+
+
 def test_shard_specs_land_on_declared_axes():
     """The group axis of every sharded array is split over the 'group' mesh
     axis and the node axis over 'node' — checked via the addressable shard

@@ -111,7 +111,10 @@ def test_three_process_cluster_kill9_restart(tmp_path):
         lane = lanes.pop()
 
         _wait(lambda: _total_acked(tmp_path, range(3)) >= 30,
-              "initial load committed", timeout=120)
+              # 240s: three processes serialize their XLA compiles on a
+              # single-core host before any of them can tick usefully —
+              # 120s was a ~25% flake under load.
+              "initial load committed", timeout=240)
 
         # kill -9 the current leader (the reference's operator action).
         def leader():

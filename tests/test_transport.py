@@ -53,7 +53,11 @@ def test_codec_roundtrip():
     assert cols.tolist() == [2] and vals.tolist() == [7]
     cols, ents = out["ae_ents"]
     assert ents.shape == (1, CFG.batch) and ents[0, :2].tolist() == [7, 7]
-    assert got_payloads == {2: (5, [b"cmd-5", b"cmd-6"])}
+    run = got_payloads[2]
+    assert list(got_payloads) == [2]
+    assert run.start == 5 and run.end == 6
+    assert run.materialize() == [b"cmd-5", b"cmd-6"]
+    assert run.entry(0) == b"cmd-5" and bytes(run.piece(0, 2)).endswith(b"-6")
     cols, vals = out["rv_prevote"]
     assert cols.tolist() == [5] and bool(vals[0])
 

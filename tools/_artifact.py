@@ -1,0 +1,60 @@
+"""Shared validation-artifact writer for the tools/ validators.
+
+Every validator run persists its raw evidence — seed, config, per-phase
+numbers, platform, wall-clock — as a committed JSON file under
+``artifacts/``, so on-device results survive as auditable artifacts
+instead of prose (the reference's verification ethos is artifact-driven:
+byte-identical output files, /root/reference/README.md:28-33).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+ARTIFACT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "artifacts")
+
+
+class PhaseLog:
+    """Collects (phase, numbers) pairs and mirrors lines to stdout."""
+
+    def __init__(self, name: str, seed: int, config: dict):
+        self.name = name
+        self.seed = seed
+        self.config = config
+        self.phases: list = []
+        self.t0 = time.time()
+
+    def phase(self, title: str, **numbers) -> None:
+        self.phases.append({"phase": title, "t_s": round(time.time()
+                                                         - self.t0, 2),
+                            **numbers})
+        nums = " ".join(f"{k}={v}" for k, v in numbers.items())
+        print(f"[{self.name}] {title}: {nums}", flush=True)
+
+    def save(self, platform: str, ok: bool = True) -> str:
+        os.makedirs(ARTIFACT_DIR, exist_ok=True)
+        stem = f"{self.name}_{platform}"
+        seq = 0
+        while os.path.exists(os.path.join(ARTIFACT_DIR,
+                                          f"{stem}_{seq:03d}.json")):
+            seq += 1
+        path = os.path.join(ARTIFACT_DIR, f"{stem}_{seq:03d}.json")
+        doc = {
+            "name": self.name,
+            "ok": ok,
+            "seed": self.seed,
+            "platform": platform,
+            "config": self.config,
+            "phases": self.phases,
+            "total_s": round(time.time() - self.t0, 2),
+            "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "argv": sys.argv[1:],
+        }
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        print(f"[{self.name}] artifact saved: {path}", flush=True)
+        return path

@@ -23,10 +23,16 @@ def main() -> None:
     import jax
     from rafting_tpu import DeviceCluster, EngineConfig, LEADER
 
+    from _artifact import PhaseLog
+
     G = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
     cfg = EngineConfig(n_groups=G, n_peers=5, log_slots=64, batch=8,
                        max_submit=8, election_ticks=10, heartbeat_ticks=3,
                        rpc_timeout_ticks=8, debug_checks=True)
+    plog = PhaseLog("config4", seed=4,
+                    config={"n_groups": G, "n_peers": 5, "log_slots": 64,
+                            "batch": 8, "max_submit": 8, "submit_n": 4,
+                            "debug_checks": True})
     c = DeviceCluster(cfg, seed=4)
     t0 = time.time()
     for _ in range(60):
@@ -35,8 +41,9 @@ def main() -> None:
     assert ((roles == LEADER).sum(axis=0) == 1).all(), "one leader per group"
     commit0 = np.asarray(c.states.commit).max(axis=0)
     assert (commit0 > 0).all()
-    print(f"elect+replicate OK: {G} groups x 5 peers, "
-          f"{time.time() - t0:.0f}s", flush=True)
+    plog.phase("elect+replicate", groups=G, peers=5,
+               elapsed_s=round(time.time() - t0, 1),
+               committed=int(commit0.astype(np.int64).sum()))
 
     # Partition: isolate a 2-node minority; the 3-node majority must keep
     # committing (deposed-leader groups re-elect behind the partition).
@@ -47,8 +54,8 @@ def main() -> None:
             c.tick(submit_n=4)
         commit1 = np.asarray(c.states.commit)[:3].max(axis=0)
         frac = float((commit1 > commit0).mean())
-        print(f"  after {30 * (k + 1)} partitioned ticks: "
-              f"{frac * 100:.3f}% of groups progressed", flush=True)
+        plog.phase("partitioned", ticks=30 * (k + 1),
+                   progressed_pct=round(frac * 100, 3))
         if frac == 1.0:
             break
     assert (commit1 > commit0).all(), \
@@ -65,7 +72,11 @@ def main() -> None:
         c.tick()
     commit2 = np.asarray(c.states.commit).max(axis=0)
     assert (commit2 > commit1).all()
-    print(f"config-4 OK on {jax.devices()[0].platform}: no same-term split "
+    platform = jax.devices()[0].platform
+    plog.phase("healed", committed=int(commit2.astype(np.int64).sum()),
+               split_brain=0)
+    plog.save(platform)
+    print(f"config-4 OK on {platform}: no same-term split "
           f"brain, all {G} groups progressed; total {time.time() - t0:.0f}s, "
           f"committed={int(commit2.astype(np.int64).sum())}", flush=True)
 

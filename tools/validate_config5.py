@@ -27,10 +27,16 @@ def main() -> None:
     import jax
     from rafting_tpu import DeviceCluster, EngineConfig, LEADER
 
+    from _artifact import PhaseLog
+
     G = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
     cfg = EngineConfig(n_groups=G, n_peers=3, log_slots=64, batch=8,
                        max_submit=8, election_ticks=10, heartbeat_ticks=3,
                        rpc_timeout_ticks=8, debug_checks=True)
+    plog = PhaseLog("config5", seed=5,
+                    config={"n_groups": G, "n_peers": 3, "log_slots": 64,
+                            "batch": 8, "max_submit": 8, "submit_n": 4,
+                            "compact_every": 16, "debug_checks": True})
     c = DeviceCluster(cfg, seed=5)
     # Discrete compaction cadence (every 16 ticks), matching real
     # checkpoint-gated compaction: a floor advancing EVERY tick outruns
@@ -42,8 +48,8 @@ def main() -> None:
         c.tick(submit_n=4)
     roles = np.asarray(c.states.role)
     assert ((roles == LEADER).sum(axis=0) == 1).all()
-    print(f"elect+replicate OK: {G} groups, {time.time() - t0:.0f}s",
-          flush=True)
+    plog.phase("elect+replicate", groups=G,
+               elapsed_s=round(time.time() - t0, 1))
 
     victim = 2
     victim_tail = np.asarray(c.states.log.last)[victim].copy()
@@ -56,8 +62,8 @@ def main() -> None:
             c.tick(submit_n=4)
         floors = np.asarray(c.states.log.base)[:2].min(axis=0)
         frac = float((floors > victim_tail).mean())
-        print(f"  after {30 * (k + 1)} isolated ticks: floors passed the "
-              f"victim's tail on {frac * 100:.2f}% of groups", flush=True)
+        plog.phase("isolated", ticks=30 * (k + 1),
+                   floors_past_victim_pct=round(frac * 100, 2))
         if frac == 1.0:
             break
     assert (np.asarray(c.states.log.base)[:2].min(axis=0)
@@ -70,8 +76,8 @@ def main() -> None:
             c.tick(submit_n=4)
         v_commit = np.asarray(c.states.commit)[victim]
         frac = float((v_commit >= commit_majority).mean())
-        print(f"  after {30 * (k + 1)} healed ticks: victim caught up on "
-              f"{frac * 100:.2f}% of groups", flush=True)
+        plog.phase("healed", ticks=30 * (k + 1),
+                   caught_up_pct=round(frac * 100, 2))
         if frac == 1.0:
             break
     v_commit = np.asarray(c.states.commit)[victim]
@@ -92,7 +98,10 @@ def main() -> None:
     lead_lanes = (np.asarray(c.states.role) == LEADER)[:, :, None]
     assert not (np.asarray(c.states.need_snap) & lead_lanes).any(), \
         "pending installations remain on live leaders after convergence"
-    print(f"config-5 OK on {jax.devices()[0].platform}: all {G} groups "
+    platform = jax.devices()[0].platform
+    plog.phase("converged", floor_jump_groups=G)
+    plog.save(platform)
+    print(f"config-5 OK on {platform}: all {G} groups "
           f"caught up via snapshot floor jump; total {time.time() - t0:.0f}s",
           flush=True)
 
