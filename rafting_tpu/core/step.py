@@ -166,6 +166,7 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     leader_id, commit = s.leader_id, s.commit
     log = s.log
     next_idx, match_idx = s.next_idx, s.match_idx
+    own_from = s.own_from
     send_next, inflight = s.send_next, s.inflight
     hb_inflight = s.hb_inflight
     sent_at, need_snap = s.sent_at, s.need_snap
@@ -276,6 +277,11 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     fail_at = jnp.where(vote_win[:, None], 0, fail_at)
     fail_streak = jnp.where(vote_win[:, None], 0, fail_streak)
     hb_due = jnp.where(vote_win, now, hb_due)
+    # First index of OUR term as leader: the slot the no-op below takes
+    # (or, with a full ring, the first future own entry).  Terms are
+    # monotone along the log, so the phase-10 own-term commit rule is
+    # exactly `quorum_idx >= own_from` — no ring gather on the hot path.
+    own_from = jnp.where(vote_win, log.last + 1, own_from)
     # Raft §8 liveness: a fresh leader appends an OWN-TERM NO-OP entry so
     # its predecessors' entries become committable immediately — the
     # commit rule (phase 10, reference Leader.java:256-261) only counts a
@@ -665,7 +671,7 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     # identical semantics either way.
     from ..ops.quorum import quorum_commit
     match_full = jnp.where(self_hot, log.last[:, None], match_idx)
-    commit = quorum_commit(cfg, match_full, log, commit, term,
+    commit = quorum_commit(cfg, match_full, log, commit, own_from,
                            active & (role == LEADER))
     match_idx = match_full
 
@@ -707,6 +713,7 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         term=term, role=role, voted_for=voted, leader_id=leader_id,
         commit=commit, applied=s.applied, log=log,
         next_idx=next_idx, match_idx=match_idx, send_next=send_next,
+        own_from=own_from,
         inflight=inflight, hb_inflight=hb_inflight, sent_at=sent_at,
         need_snap=need_snap,
         ok_at=ok_at, fail_at=fail_at, fail_streak=fail_streak,

@@ -152,6 +152,14 @@ class RaftState:
 
     # Leader-side replication bookkeeping (reference Leadership.State,
     # context/member/Leadership.java:30-114).
+    own_from: jax.Array       # [G] int32 — as leader: first log index of OUR
+                              #   current term (set at election win = the
+                              #   no-op's index).  Terms are monotone along
+                              #   the log, so the commit-only-own-term rule
+                              #   (Raft §5.4.2) reduces to quorum_idx >=
+                              #   own_from — no ring gather on the commit
+                              #   hot path (ops/quorum.py).  Only meaningful
+                              #   while role == LEADER.
     next_idx: jax.Array       # [G, P] int32 — ack base: first un-ACKed index
     match_idx: jax.Array      # [G, P] int32
     send_next: jax.Array      # [G, P] int32 — pipeline head: next index to ship
@@ -383,6 +391,7 @@ def init_state(cfg: EngineConfig, node_id: int, seed: int = 0,
         match_idx=z(G, P),
         send_next=jnp.ones((G, P), I32),
         inflight=z(G, P),
+        own_from=z(G),
         hb_inflight=z(G, P),
         sent_at=z(G, P),
         need_snap=jnp.zeros((G, P), jnp.bool_),

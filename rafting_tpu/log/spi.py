@@ -13,6 +13,20 @@ Implementations in-tree: :class:`rafting_tpu.log.store.LogStore` (segmented
 group-commit WAL, native C++ engine with a byte-compatible Python fallback)
 and :class:`rafting_tpu.log.memstore.MemoryLogStore` (non-durable, for
 tests/ephemeral groups).  Swap via ``RaftFactory.log_store``.
+
+Optional arena fast paths (the node runtime probes with ``getattr`` and
+falls back to the protocol methods below when absent, so third-party
+stores keep working unchanged):
+
+* ``append_spans(spans)`` — stage a whole tick's appends as contiguous
+  spans ``(group, start_index, buffer, lens_u32, terms)`` whose payload
+  bytes sit back-to-back in ``buffer`` (terms: int64 vector or a plain
+  int).  LogStore crosses into its native engine ONCE per tick with
+  pointer vectors; a store without it receives per-entry materialized
+  lists through :meth:`append_batch`.
+* ``payload_runs(g, start, n) -> (pieces, lens_u32) | None`` — zero-copy
+  window read consumed by the wire pack path and arena-aware machines
+  (``RaftMachine.apply_run``); ``None`` iff an entry is absent.
 """
 
 from __future__ import annotations

@@ -89,10 +89,8 @@ class LogStore:
         i = bisect_right(starts, idx)
         if i > 0 and runs[i - 1].end >= idx:
             return                      # already covered
-        run = PayloadRun(idx, payload, np.zeros(1, np.uint64),
-                         np.asarray([len(payload)], np.uint32))
         starts.insert(i, idx)
-        runs.insert(i, run)
+        runs.insert(i, PayloadRun.single(idx, payload))
 
     # -- staging writes (durable after sync()) ------------------------------
 

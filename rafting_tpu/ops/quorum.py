@@ -6,16 +6,20 @@ context/member/Leader.java:247-280, Leadership.java:116-130):
 
   1. quorum index = majority-order statistic of the (group x peer) match
      matrix (self slot pre-filled with the leader's own last index);
-  2. the commit-only-own-term rule: advance only if the entry at the
-     quorum index carries the CURRENT term (Raft §5.4.2,
-     Leader.java:256-261);
+  2. the commit-only-own-term rule (Raft §5.4.2, Leader.java:256-261),
+     reduced to ``quorum_idx >= own_from`` — terms are monotone along the
+     log and ``own_from`` (RaftState) is the first index of the leader's
+     current term, pinned at election win by the §8 no-op.  Round 4's
+     kernel instead looked the term up in the ring with an O(L) unrolled
+     select (fine at L=64, 4x the work at the tuned L=256 and pure
+     overhead on every lane); the reduction deletes that loop AND the
+     [L, G] ring transfer from the kernel entirely, and drops the
+     dynamic ring gather from the inline path too;
   3. masked monotone update of commitIndex.
 
 Layout: group-major arrays are reshaped to [rows, 128] so the group axis
 rides the TPU lanes; the peer axis (3-9) is a static unroll of an
-odd-even transposition sorting network on [rows, 128] tiles in VMEM; the
-per-group ring-term lookup is an unrolled select over the ring's L slots
-(no per-lane dynamic addressing on TPU).
+odd-even transposition sorting network on [rows, 128] tiles in VMEM.
 
 ``quorum_commit`` dispatches to the Pallas kernel or the pure-jnp
 reference (identical semantics, parity-tested in tests/test_ops.py).
@@ -38,22 +42,22 @@ LANES = 128
 
 # ---------------------------------------------------------------- reference --
 
-def quorum_commit_ref(match_full: jax.Array, ring_term_at_quorum, commit,
-                      term, can_lead, majority: int) -> jax.Array:
+def quorum_commit_ref(match_full: jax.Array, own_from, last, commit,
+                      can_lead, majority: int) -> jax.Array:
     """Pure-jnp reference (exactly core/step.py phase 10)."""
     P = match_full.shape[1]
     sorted_m = jnp.sort(match_full, axis=1)
     quorum_idx = sorted_m[:, P - majority]
     can = can_lead & (quorum_idx > commit) & \
-        (ring_term_at_quorum(quorum_idx) == term)
+        (quorum_idx >= own_from) & (quorum_idx <= last)
     return jnp.where(can, quorum_idx, commit)
 
 
 # ------------------------------------------------------------------- kernel --
 
-def _kernel(P: int, L: int, majority: int,
-            match_ref, ring_ref, base_ref, base_term_ref, last_ref,
-            commit_ref, term_ref, lead_ref, out_ref):
+def _kernel(P: int, majority: int,
+            match_ref, own_from_ref, last_ref, commit_ref, lead_ref,
+            out_ref):
     # Load the P match planes ([R, 128] tiles) and run an odd-even
     # transposition network; after P passes the planes are sorted
     # ascending, so plane P-majority is the quorum order statistic.
@@ -69,23 +73,9 @@ def _kernel(P: int, L: int, majority: int,
             planes[i], planes[i + 1] = lo, hi
     q = planes[P - majority]
 
-    base = base_ref[...]
-    last = last_ref[...]
     commit = commit_ref[...]
-    term = term_ref[...]
-    lead = lead_ref[...]
-
-    # Ring term at the quorum index: unrolled select over the L slots
-    # (ring layout is slot-major [L, R, 128]).  Semantics match
-    # core/step.py ring_term_at: <= base -> base_term; > last -> -1.
-    slot = jnp.remainder(q, L)
-    t_at = jnp.full_like(q, -1)
-    for l in range(L):
-        t_at = jnp.where(slot == l, ring_ref[l], t_at)
-    t_at = jnp.where(q <= base, base_term_ref[...],
-                     jnp.where(q <= last, t_at, jnp.full_like(q, -1)))
-
-    can = (lead != 0) & (q > commit) & (t_at == term)
+    can = ((lead_ref[...] != 0) & (q > commit)
+           & (q >= own_from_ref[...]) & (q <= last_ref[...]))
     out_ref[...] = jnp.where(can, q, commit)
 
 
@@ -96,18 +86,17 @@ def _pad_rows(a: np.ndarray | jax.Array, G: int, Gp: int, fill=0):
     return jnp.pad(a, pad, constant_values=fill)
 
 
-@functools.partial(jax.jit, static_argnums=(6, 7))
-def quorum_commit_pallas(match_full, log_term_ring, base, base_term, last,
-                         state_vec, majority: int, interpret: bool = False
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def quorum_commit_pallas(match_full, own_from, state_vec,
+                         majority: int, interpret: bool = False
                          ) -> jax.Array:
-    """Pallas path.  ``state_vec`` packs (commit, term, can_lead) as a
+    """Pallas path.  ``state_vec`` packs (commit, last, can_lead) as a
     [3, G] i32 array (can_lead nonzero = active leader lane)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     G, P = match_full.shape
-    L = log_term_ring.shape[1]
-    commit, term, can_lead = state_vec[0], state_vec[1], state_vec[2]
+    commit, last, can_lead = state_vec[0], state_vec[1], state_vec[2]
 
     step = BLOCK_ROWS * LANES
     Gp = (G + step - 1) // step * step
@@ -117,35 +106,30 @@ def quorum_commit_pallas(match_full, log_term_ring, base, base_term, last,
         return _pad_rows(v, G, Gp, fill).reshape(R, LANES)
 
     match_t = _pad_rows(match_full, G, Gp).T.reshape(P, R, LANES)
-    ring_t = _pad_rows(log_term_ring, G, Gp).T.reshape(L, R, LANES)
 
     grid = (R // BLOCK_ROWS,)
     vec = lambda: pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0))
     out = pl.pallas_call(
-        functools.partial(_kernel, P, L, majority),
+        functools.partial(_kernel, P, majority),
         out_shape=jax.ShapeDtypeStruct((R, LANES), jnp.int32),
         grid=grid,
         in_specs=[
             pl.BlockSpec((P, BLOCK_ROWS, LANES), lambda i: (0, i, 0)),
-            pl.BlockSpec((L, BLOCK_ROWS, LANES), lambda i: (0, i, 0)),
-            vec(), vec(), vec(), vec(), vec(), vec(),
+            vec(), vec(), vec(), vec(),
         ],
         out_specs=vec(),
         interpret=interpret,
-    )(match_t, ring_t, rows(base), rows(base_term), rows(last),
-      rows(commit), rows(term), rows(can_lead))
+    )(match_t, rows(own_from, fill=1), rows(last), rows(commit),
+      rows(can_lead))
     return out.reshape(Gp)[:G]
 
 
-def quorum_commit(cfg, match_full, log, commit, term, can_lead):
+def quorum_commit(cfg, match_full, log, commit, own_from, can_lead):
     """Dispatch: Pallas when ``cfg.use_pallas``, else inline jnp (the
     default; both paths are semantically identical)."""
-    from ..core.step import ring_term_at
-
     if getattr(cfg, "use_pallas", False):
         import os
-        state_vec = jnp.stack(
-            [commit, term, can_lead.astype(I32)])
+        state_vec = jnp.stack([commit, log.last, can_lead.astype(I32)])
         # Interpret only on the CPU backend; any accelerator attempts the
         # compiled lowering (an unsupported backend then fails LOUDLY
         # instead of silently running the interpreter at 1000x cost — the
@@ -158,8 +142,7 @@ def quorum_commit(cfg, match_full, log, commit, term, can_lead):
         else:
             interpret = jax.default_backend() == "cpu"
         return quorum_commit_pallas(
-            match_full, log.term, log.base, log.base_term, log.last,
-            state_vec, cfg.majority, interpret)
+            match_full, own_from, state_vec, cfg.majority, interpret)
     P = match_full.shape[1]
     if P == 3 and cfg.majority == 2:
         # 3-peer fast path: the quorum index is the median — three
@@ -172,5 +155,5 @@ def quorum_commit(cfg, match_full, log, commit, term, can_lead):
         sorted_m = jnp.sort(match_full, axis=1)
         quorum_idx = sorted_m[:, P - cfg.majority]
     can = can_lead & (quorum_idx > commit) & \
-        (ring_term_at(log, quorum_idx) == term)
+        (quorum_idx >= own_from) & (quorum_idx <= log.last)
     return jnp.where(can, quorum_idx, commit)

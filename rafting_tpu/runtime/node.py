@@ -429,9 +429,7 @@ class RaftNode:
         if err is not None:
             fut.set_exception(err)
             return fut
-        run = PayloadRun(0, payload,
-                         np.zeros(1, np.uint64),
-                         np.asarray([len(payload)], np.uint32))
+        run = PayloadRun.single(0, payload)
         with self._submit_lock:
             if (int(self._queued_n[group]) >= self.group_queue_cap
                     or self._queued_total
@@ -497,9 +495,7 @@ class RaftNode:
         n = len(payloads)
         if n == 0:
             for _ in groups:
-                s = BatchSubmit(0, eager=False)
-                s._remaining = 0
-                sinks.append(s)
+                sinks.append(BatchSubmit(0, eager=False))
             return sinks
         run = PayloadRun.from_payloads(0, payloads)
         # Refusal prechecks read the tick-refreshed mirrors (same bounded
@@ -993,6 +989,7 @@ class RaftNode:
             send_next=s.send_next.at[idx].set(1),
             inflight=s.inflight.at[idx].set(0),
             hb_inflight=s.hb_inflight.at[idx].set(0),
+            own_from=s.own_from.at[idx].set(0),
             sent_at=s.sent_at.at[idx].set(0),
             need_snap=s.need_snap.at[idx].set(False),
             ok_at=s.ok_at.at[idx].set(0),

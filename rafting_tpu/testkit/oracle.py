@@ -96,6 +96,7 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     base_term = s["log.base_term"].copy()
     last = s["log.last"].copy()
     next_idx = s["next_idx"].copy()
+    own_from_a = s["own_from"].astype(np.int64).copy()
     match_idx = s["match_idx"].copy()
     send_next = s["send_next"].copy()
     inflight = s["inflight"].copy()
@@ -249,6 +250,7 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
             fail_at[g] = 0
             fail_streak[g] = 0
             hb_due[g] = now
+            own_from_a[g] = log.last + 1
             # Raft §8 no-op on election win (mirrors kernel phase 3):
             # appended AFTER the replication matrix reset, so
             # next/send point exactly at the no-op.
@@ -552,8 +554,11 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         full = match_idx[g].copy()
         full[me] = log.last
         quorum_idx = int(np.sort(full)[P - maj])
+        # Own-term rule via own_from (terms monotone along the log; set at
+        # election win) — mirrors ops/quorum.py exactly.
         if (active[g] and role[g] == LEADER and quorum_idx > commit[g]
-                and log.term_at(quorum_idx) == term[g]):
+                and quorum_idx >= own_from_a[g]
+                and quorum_idx <= log.last):
             commit[g] = quorum_idx
         match_idx[g] = full
 
@@ -580,6 +585,7 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         "applied": s["applied"],
         "log.term": ring, "log.base": base, "log.base_term": base_term,
         "log.last": last,
+        "own_from": own_from_a.astype(np.int32),
         "next_idx": next_idx, "match_idx": match_idx,
         "send_next": send_next, "inflight": inflight,
         "hb_inflight": hb_inflight,

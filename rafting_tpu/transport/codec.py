@@ -94,6 +94,13 @@ class PayloadRun:
                 for k in range(k0, k0 + n)]
 
     @classmethod
+    def single(cls, start: int, payload: bytes) -> "PayloadRun":
+        """One-entry run (the submit() / cache-backfill shape) — ONE
+        definition of the degenerate arena layout."""
+        return cls(start, payload, np.zeros(1, np.uint64),
+                   np.asarray([len(payload)], np.uint32))
+
+    @classmethod
     def from_payloads(cls, start: int, payloads) -> "PayloadRun":
         """Build an arena run from a list of bytes (client submission
         path): one join + two vector ops, no per-entry records."""

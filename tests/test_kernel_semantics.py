@@ -181,11 +181,14 @@ def test_commit_only_own_term():
     cfg = cfg3()
     st = follower_with_log(cfg, term=2, entry_terms=[1, 1])
     # Force leadership at term 2 with a fully-matched old-term log.
+    # own_from = 3 is what the election-win phase would have set (first
+    # index of OUR term = tail+1; the rule under test is quorum >= it).
     st = st.replace(
         role=jnp.asarray([LEADER], I32),
         leader_id=jnp.asarray([0], I32),
         match_idx=jnp.asarray([[2, 2, 2]], I32),
         next_idx=jnp.asarray([[3, 3, 3]], I32),
+        own_from=jnp.asarray([3], I32),
     )
     st2, _, _ = node_step(cfg, st, Messages.empty(cfg), HostInbox.empty(cfg))
     assert int(st2.commit[0]) == 0, "old-term entries need a new-term cover"

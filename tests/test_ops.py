@@ -8,7 +8,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from rafting_tpu.core.types import EngineConfig, LogState
+from rafting_tpu.core.types import EngineConfig
 from rafting_tpu.ops.quorum import (
     quorum_commit_pallas, quorum_commit_ref,
 )
@@ -19,32 +19,33 @@ def _random_case(rng, G, P, L):
     base = rng.integers(0, 5, G).astype(np.int32)
     length = rng.integers(0, L - 5, G).astype(np.int32)
     last = base + length
-    ring = rng.integers(1, 9, (G, L)).astype(np.int32)
-    base_term = rng.integers(1, 9, G).astype(np.int32)
     match = rng.integers(0, L, (G, P)).astype(np.int32)
     match[:, 0] = last  # self slot = own last
     commit = np.minimum(rng.integers(0, L, G), last).astype(np.int32)
-    term = rng.integers(1, 9, G).astype(np.int32)
+    # First own-term index: anywhere from below base to beyond last
+    # (exercises both grant and refuse sides of the own-term rule).
+    own_from = rng.integers(0, L + 4, G).astype(np.int32)
     lead = (rng.random(G) < 0.7)
-    log = LogState(term=jnp.asarray(ring), base=jnp.asarray(base),
-                   base_term=jnp.asarray(base_term), last=jnp.asarray(last))
-    return (log, jnp.asarray(match), jnp.asarray(commit), jnp.asarray(term),
-            jnp.asarray(lead))
+    return (jnp.asarray(match), jnp.asarray(own_from), jnp.asarray(last),
+            jnp.asarray(commit), jnp.asarray(lead))
 
 
-@pytest.mark.parametrize("P,majority", [(3, 2), (5, 3), (7, 4)])
-def test_pallas_quorum_matches_reference(P, majority):
-    from rafting_tpu.core.step import ring_term_at
-
+# L=256 with P=5 is the TUNED bench shape (config-4's peer count with
+# bench_runtime's ring) — the r4 kernel's O(L) unrolled ring select made
+# exactly this shape 4x more expensive than the benched L=64; the
+# own_from reduction removed the ring from the kernel entirely, and this
+# parametrization keeps the tuned shape pinned in the suite.
+@pytest.mark.parametrize("P,majority,L", [(3, 2, 16), (5, 3, 256),
+                                          (7, 4, 64)])
+def test_pallas_quorum_matches_reference(P, majority, L):
     rng = np.random.default_rng(42 + P)
-    G, L = 1000, 16   # odd G exercises lane padding
-    log, match, commit, term, lead = _random_case(rng, G, P, L)
-    ref = quorum_commit_ref(
-        match, lambda q: ring_term_at(log, q), commit, term, lead, majority)
-    state_vec = jnp.stack([commit, term, lead.astype(jnp.int32)])
+    G = 1000   # odd G exercises lane padding
+    match, own_from, last, commit, lead = _random_case(rng, G, P, L)
+    ref = quorum_commit_ref(match, own_from, last, commit, lead, majority)
+    state_vec = jnp.stack([commit, last, lead.astype(jnp.int32)])
     interpret = jax.default_backend() != "tpu"
-    got = quorum_commit_pallas(match, log.term, log.base, log.base_term,
-                               log.last, state_vec, majority, interpret)
+    got = quorum_commit_pallas(match, own_from, state_vec, majority,
+                               interpret)
     np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
 
 
