@@ -380,6 +380,11 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     # charge the reply against the in-flight window (phase 9), so it must
     # not decrement it either.
     out_aer_empty = ae_v & (inbox.ae_n == 0)
+    # Echo the occupancy flag (symmetric with is_probe): only replies to
+    # OCCUPYING heartbeats release a sender slot — a reply to a
+    # window-full exempt heartbeat must not free a slot whose real ack
+    # was lost (it would disarm the RPC-timeout detector one cadence).
+    out_aer_occ = ae_v & inbox.ae_occ
 
     # ---- 5. InstallSnapshot ------------------------------------------------
     # Device plane: an offer merely tells the follower's host to start the
@@ -460,23 +465,17 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     need_snap = jnp.where(aer_r, aer_fail & (nx <= log.base[:, None]),
                           need_snap)
     next_idx = jnp.maximum(nx, log.base[:, None] + 1)
-    # Pipeline accounting: data-batch replies release a data slot,
-    # heartbeat replies (echoed as aer_empty) release a heartbeat slot —
-    # the two occupancy lanes never cross.  Within the heartbeat lane the
-    # count is CONSERVATIVE, not exact: aer_empty is inferred from
-    # ae_n==0, so a reply to a slot-EXEMPT heartbeat (sent while the
-    # window was full, phase 9) is indistinguishable from a reply to an
-    # OCCUPYING one and can release a slot whose own ack was lost.  The
-    # effect is bounded flow-control slack — the RPC-timeout detector for
-    # that peer re-arms on the next occupying heartbeat (one cadence
-    # later); counters clamp at 0 and Raft safety is untouched.  Making
-    # it exact needs an occupied/exempt flag echoed on the AE itself
-    # (symmetric with is_probe) — a wire-schema field not worth the cost
-    # at this severity.  A rejection aborts the whole window so
+    # Pipeline accounting: data-batch replies release a data slot;
+    # heartbeat replies release a heartbeat slot ONLY when they echo the
+    # occupancy flag (aer_empty & aer_occ) — the AE itself carries
+    # whether it occupied a slot (ae_occ, phase 9; symmetric with
+    # is_probe), so a reply to a window-full EXEMPT heartbeat can never
+    # free a slot whose real ack was lost, and the window count stays
+    # exact (ADVICE r4).  A rejection aborts the whole window so
     # replication resumes from the clamped next_idx (reference: nextIndex
     # rollback cancels optimistic sends, Leadership.updateIndex:75-114).
     aer_ack = aer_r & ~inbox.aer_empty.T
-    aer_hb_ack = aer_r & inbox.aer_empty.T
+    aer_hb_ack = aer_r & inbox.aer_empty.T & inbox.aer_occ.T
     inflight = jnp.where(aer_ack, jnp.maximum(inflight - 1, 0), inflight)
     hb_inflight = jnp.where(aer_hb_ack, jnp.maximum(hb_inflight - 1, 0),
                             hb_inflight)
@@ -614,6 +613,7 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     out_ae_commit = jnp.broadcast_to(commit[None, :], (P, G))
     out_ae_n = n_send.T
     out_ae_ents = jnp.swapaxes(ents_all, 0, 1)                   # [P, G, B]
+    out_ae_occ = hb_occupy.T
     # Snapshot offer for laggards (reference Leader.java:168-190); occupies
     # the whole window (one offer at a time), re-offered on the heartbeat
     # cadence while un-acked — the re-offer is window-exempt like a
@@ -724,9 +724,10 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         ae_valid=out_ae_valid, ae_term=out_ae_term,
         ae_prev_idx=out_ae_prev_idx, ae_prev_term=out_ae_prev_term,
         ae_commit=out_ae_commit, ae_n=out_ae_n, ae_ents=out_ae_ents,
+        ae_occ=out_ae_occ,
         aer_valid=out_aer_valid, aer_term=out_aer_term,
         aer_success=out_aer_success, aer_match=out_aer_match,
-        aer_empty=out_aer_empty,
+        aer_empty=out_aer_empty, aer_occ=out_aer_occ,
         rv_valid=out_rv_valid, rv_term=out_rv_term,
         rv_last_idx=out_rv_last_idx, rv_last_term=out_rv_last_term,
         rv_prevote=out_rv_prevote,

@@ -214,6 +214,12 @@ class Messages:
     ae_commit: jax.Array     # [P, G] int32 — leaderCommit
     ae_n: jax.Array          # [P, G] int32 — entry count (<= B)
     ae_ents: jax.Array       # [P, G, B] int32 — entry terms
+    ae_occ: jax.Array        # [P, G] bool — this (empty) AE OCCUPIES a
+                             #   heartbeat window slot on its sender; echoed
+                             #   back as aer_occ so only replies to occupying
+                             #   heartbeats release hb_inflight (a reply to a
+                             #   window-full EXEMPT heartbeat must not free a
+                             #   slot whose own ack was lost — ADVICE r4)
 
     # AppendEntries response (reference RaftResponse + match bookkeeping)
     aer_valid: jax.Array     # [P, G] bool
@@ -224,6 +230,9 @@ class Messages:
                              #   window-exempt on the sender, so the leader
                              #   skips the inflight decrement (exact window
                              #   accounting; see step.py phase 9)
+    aer_occ: jax.Array       # [P, G] bool — echo of the AE's ae_occ flag
+                             #   (meaningful with aer_empty; symmetric with
+                             #   is_probe/isr_probe)
 
     # RequestVote / PreVote request (reference Follower.prepareElection,
     # Candidate.startElection)
@@ -265,9 +274,9 @@ class Messages:
         return cls(
             ae_valid=f(P, G), ae_term=z(P, G), ae_prev_idx=z(P, G),
             ae_prev_term=z(P, G), ae_commit=z(P, G), ae_n=z(P, G),
-            ae_ents=z(P, G, B),
+            ae_ents=z(P, G, B), ae_occ=f(P, G),
             aer_valid=f(P, G), aer_term=z(P, G), aer_success=f(P, G),
-            aer_match=z(P, G), aer_empty=f(P, G),
+            aer_match=z(P, G), aer_empty=f(P, G), aer_occ=f(P, G),
             rv_valid=f(P, G), rv_term=z(P, G), rv_last_idx=z(P, G),
             rv_last_term=z(P, G), rv_prevote=f(P, G),
             rvr_valid=f(P, G), rvr_term=z(P, G), rvr_granted=f(P, G),

@@ -125,10 +125,10 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     out = {
         "ae_valid": zb(P, G), "ae_term": zi(P, G), "ae_prev_idx": zi(P, G),
         "ae_prev_term": zi(P, G), "ae_commit": zi(P, G), "ae_n": zi(P, G),
-        "ae_ents": zi(P, G, B),
+        "ae_ents": zi(P, G, B), "ae_occ": zb(P, G),
         "aer_valid": zb(P, G), "aer_term": zi(P, G),
         "aer_success": zb(P, G), "aer_match": zi(P, G),
-        "aer_empty": zb(P, G),
+        "aer_empty": zb(P, G), "aer_occ": zb(P, G),
         "rv_valid": zb(P, G), "rv_term": zi(P, G), "rv_last_idx": zi(P, G),
         "rv_last_term": zi(P, G), "rv_prevote": zb(P, G),
         "rvr_valid": zb(P, G), "rvr_term": zi(P, G), "rvr_granted": zb(P, G),
@@ -313,6 +313,7 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
                 # Heartbeat echo: the sender never charged an empty AE
                 # against its window, so the reply must not decrement it.
                 out["aer_empty"][p, g] = int(ib["ae_n"][p, g]) == 0
+                out["aer_occ"][p, g] = bool(ib["ae_occ"][p, g])
 
         # ---- 5. InstallSnapshot -------------------------------------------
         # (reference Follower.installSnapshot:130-153 + host completion,
@@ -383,7 +384,8 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
                 # Heartbeat replies (aer_empty) release a heartbeat slot;
                 # data replies release a data slot (lanes never cross).
                 if ib["aer_empty"][p, g]:
-                    hb_inflight[g, p] = max(hb_inflight[g, p] - 1, 0)
+                    if ib["aer_occ"][p, g]:
+                        hb_inflight[g, p] = max(hb_inflight[g, p] - 1, 0)
                 else:
                     inflight[g, p] = max(inflight[g, p] - 1, 0)
                 if not ib["aer_success"][p, g]:

@@ -115,9 +115,9 @@ class PayloadRun:
 # order; dtypes/shapes come from the Messages template at pack/unpack time.
 KIND_FIELDS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "ae": ("ae_valid", ("ae_term", "ae_prev_idx", "ae_prev_term",
-                        "ae_commit", "ae_n", "ae_ents")),
+                        "ae_commit", "ae_n", "ae_ents", "ae_occ")),
     "aer": ("aer_valid", ("aer_term", "aer_success", "aer_match",
-                          "aer_empty")),
+                          "aer_empty", "aer_occ")),
     "rv": ("rv_valid", ("rv_term", "rv_last_idx", "rv_last_term",
                         "rv_prevote")),
     "rvr": ("rvr_valid", ("rvr_term", "rvr_granted", "rvr_prevote",

@@ -217,6 +217,52 @@ def test_heartbeat_reply_echoes_empty_flag():
     assert not bool(out.aer_empty[1, 0]) and bool(out.aer_success[1, 0])
 
 
+def test_exempt_heartbeat_reply_cannot_release_hb_slot():
+    """Only replies to OCCUPYING heartbeats (aer_empty & aer_occ) release
+    hb_inflight (ADVICE r4): a reply to a window-full slot-EXEMPT
+    heartbeat (ae_occ=False) must not free a slot whose real ack was
+    lost — that would disarm the RPC-timeout failure detector for the
+    lost reply.  The follower echoes the AE's ae_occ verbatim; the
+    leader's release honors it."""
+    cfg = cfg3()
+    # Follower side: ae_occ echoes through.
+    st = follower_with_log(cfg, term=2, entry_terms=[1, 1, 1])
+    hb = ae_from(cfg, peer=1, term=2, prev_idx=3, prev_term=1, n=0)
+    hb = hb.replace(ae_occ=hb.ae_occ.at[1].set(jnp.asarray([True])))
+    _, out, _ = node_step(cfg, st, hb, HostInbox.empty(cfg))
+    assert bool(out.aer_empty[1, 0]) and bool(out.aer_occ[1, 0])
+    st = follower_with_log(cfg, term=2, entry_terms=[1, 1, 1])
+    hb = ae_from(cfg, peer=1, term=2, prev_idx=3, prev_term=1, n=0)
+    _, out, _ = node_step(cfg, st, hb, HostInbox.empty(cfg))
+    assert bool(out.aer_empty[1, 0]) and not bool(out.aer_occ[1, 0])
+
+    # Leader side: an exempt-echo reply leaves hb_inflight untouched; an
+    # occupying-echo reply releases it.
+    for occ, expect in ((False, 2), (True, 1)):
+        st = follower_with_log(cfg, term=2, entry_terms=[2, 2])
+        st = st.replace(
+            role=jnp.asarray([LEADER], I32),
+            leader_id=jnp.asarray([0], I32),
+            own_from=jnp.asarray([1], I32),
+            hb_inflight=jnp.asarray([[0, 2, 0]], I32),
+            # keep this tick free of NEW heartbeats so the lane isolates
+            # the release decision
+            hb_due=jnp.asarray([1000], I32),
+        )
+        reply = Messages.empty(cfg)
+        reply = reply.replace(
+            aer_valid=reply.aer_valid.at[1].set(jnp.asarray([True])),
+            aer_term=reply.aer_term.at[1].set(jnp.asarray([2])),
+            aer_success=reply.aer_success.at[1].set(jnp.asarray([True])),
+            aer_match=reply.aer_match.at[1].set(jnp.asarray([2])),
+            aer_empty=reply.aer_empty.at[1].set(jnp.asarray([True])),
+            aer_occ=reply.aer_occ.at[1].set(jnp.asarray([occ])),
+        )
+        st2, _, _ = node_step(cfg, st, reply, HostInbox.empty(cfg))
+        assert int(st2.hb_inflight[0, 1]) == expect, \
+            f"occ={occ}: hb_inflight {int(st2.hb_inflight[0, 1])}"
+
+
 def test_full_window_still_emits_heartbeats():
     """A leader whose data window is saturated still emits empty AEs on
     the heartbeat cadence (slot-exempt; the starvation fix the wedged-
