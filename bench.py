@@ -72,6 +72,14 @@ def child_run(n_groups: int, measure_ticks: int, warmup_ticks: int,
     init_s = time.perf_counter() - t_init
 
     n_peers = 3
+    # BENCH_NEMESIS=1: measure commits/sec UNDER the standard three-regime
+    # fault schedule (testkit/nemesis.chaos_mix, seed 0: partitions ->
+    # crash/stall storm -> lossy+duplicating links) instead of a healthy
+    # network — the honest number behind the BASELINE config-4 "under
+    # partition" target.  Warm-up stays healthy (elect + reach steady
+    # state); only the measured window runs the schedule, entirely inside
+    # fused scans.
+    nemesis_on = env_flag("BENCH_NEMESIS")
     # Pipeline budget knobs.  Defaults are the proven-on-TPU envelope
     # (r1 data was taken at L=64/B=8); the CPU fallback overrides them to
     # the tuned point from the 32k-group sweep (S=32/B=32/L=256 ~ 2.1x —
@@ -131,6 +139,22 @@ def child_run(n_groups: int, measure_ticks: int, warmup_ticks: int,
             done += step
         return states, inflight, info
 
+    if nemesis_on:
+        from rafting_tpu.core.sim import run_cluster_ticks_nemesis
+        from rafting_tpu.testkit import nemesis as _nem
+        sched = _nem.chaos_mix(n_peers, measure_ticks, seed=0)
+
+        def run_chunks_faulted(states, inflight, info):
+            done = 0
+            while done < measure_ticks:
+                step = min(chunk, measure_ticks - done)
+                states, inflight, info = run_cluster_ticks_nemesis(
+                    cfg, states, inflight, info,
+                    jax.tree.map(lambda a: a[done:done + step], sched),
+                    submit)
+                done += step
+            return states, inflight, info
+
     def commit_sum(states):
         # Device->host read: the ONLY reliable execution fence here.
         return int(np.asarray(states.commit).max(axis=0)
@@ -140,14 +164,29 @@ def child_run(n_groups: int, measure_ticks: int, warmup_ticks: int,
     t0 = time.perf_counter()
     states, inflight, info = run_chunks(warmup_ticks, c.states, c.inflight,
                                         c.last_info)
+    if nemesis_on:
+        # Compile the nemesis scan during warm-up, NOT inside measure():
+        # one execution per distinct step size of the measured chunk
+        # sequence, driven by an all-healthy schedule (the compiled
+        # program is identical — the fault schedule is data), so the
+        # faults-on headline times pure execution like the healthy one.
+        for step in sorted({min(chunk, measure_ticks - d)
+                            for d in range(0, measure_ticks, chunk)}):
+            states, inflight, info = run_cluster_ticks_nemesis(
+                cfg, states, inflight, info,
+                _nem.healthy(n_peers, step), submit)
     start_commit = commit_sum(states)
     warm_s = time.perf_counter() - t0
 
     def measure():
         nonlocal states, inflight, info
         t0 = time.perf_counter()
-        states, inflight, info = run_chunks(measure_ticks, states, inflight,
-                                            info)
+        if nemesis_on:
+            states, inflight, info = run_chunks_faulted(states, inflight,
+                                                        info)
+        else:
+            states, inflight, info = run_chunks(measure_ticks, states,
+                                                inflight, info)
         # The commit read fences the elapsed time; its cost ([N, G] i32
         # pull) is part of the measurement and negligible at every scale.
         commit_sum(states)
@@ -160,10 +199,15 @@ def child_run(n_groups: int, measure_ticks: int, warmup_ticks: int,
     end_commit = int(np.asarray(states.commit).max(axis=0).astype(np.int64).sum())
     commits = end_commit - start_commit
 
-    # Sanity: every group must have exactly one leader and nonzero commits.
+    # Sanity: nonzero commits always; exactly one leader per group only on
+    # the healthy path (mid-chaos a deposed minority leader may linger at
+    # a lower term — legal Raft, so the faulted run asserts AT LEAST one).
     roles = np.asarray(states.role)
     n_lead = (roles == 3).sum(axis=0)
-    assert (n_lead == 1).all(), f"leaders per group: {np.unique(n_lead)}"
+    if nemesis_on:
+        assert (n_lead >= 1).any(), "no leaders anywhere after chaos"
+    else:
+        assert (n_lead == 1).all(), f"leaders per group: {np.unique(n_lead)}"
     assert commits > 0
 
     faulthandler.cancel_dump_traceback_later()
@@ -176,6 +220,7 @@ def child_run(n_groups: int, measure_ticks: int, warmup_ticks: int,
         "elapsed_s": round(elapsed, 4),
         "warmup_s": round(warm_s, 2),
         "init_s": round(init_s, 2),
+        "nemesis": nemesis_on,
     }
 
 
@@ -185,6 +230,8 @@ def headline(res: dict, fallback: str = "", tuned: bool = False,
     tag = "" if plat == "cpu" else " on device"
     note = f" [CPU FALLBACK — {fallback}]" if fallback else ""
     note += TUNED_TAG if tuned else ""
+    if res.get("nemesis"):
+        note += " [NEMESIS: three-regime fault schedule on]"
     note += f" [{extra_note}]" if extra_note else ""
     return {
         # "device engine, payload-free": the full consensus protocol
@@ -440,6 +487,27 @@ def main() -> None:
         if (best["platform"] == "cpu"
                 and not any(k in os.environ for k in TUNED_ENV)):
             bonus(TUNED_ENV, "tuned budget", 96, 48, bonus_timeout)
+
+    # Faults-on stage: commits/sec under the standard nemesis schedule at
+    # the best surviving scale — a SEPARATE headline (chaos throughput is
+    # not comparable to the healthy number, so it never replaces `best`).
+    # Skipped when the operator already pinned BENCH_NEMESIS (then the
+    # whole ladder above was the faults-on run).
+    if best is not None and "BENCH_NEMESIS" not in os.environ:
+        remaining = budget - (time.monotonic() - t_start)
+        nem_timeout = float(os.environ.get("BENCH_NEMESIS_TIMEOUT", "300"))
+        if remaining >= nem_timeout * 0.4:
+            ticks, warmup = ((512, 128) if best["platform"] != "cpu"
+                             else (96, 48))
+            res = run_scale(best["scale"], ticks, warmup,
+                            min(nem_timeout, remaining),
+                            platform="cpu" if best["platform"] == "cpu"
+                            else "",
+                            extra_env={"BENCH_NEMESIS": "1"})
+            if res is not None:
+                sys.stderr.write(f"[bench] nemesis faults-on: "
+                                 f"{res['cps']:,.0f} commits/s\n")
+                emit(headline(res))
 
 
 if __name__ == "__main__":

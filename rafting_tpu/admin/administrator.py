@@ -64,6 +64,8 @@ class LifecycleBus:
 class Administrator:
     """RaftMachine for the admin lane (machine/spi.py contract)."""
 
+    applies_empty = True   # election no-ops advance last_applied, no effects
+
     def __init__(self, path: str, n_groups: int, bus: LifecycleBus):
         self.path = path       # checkpoint file directory
         self.n_groups = n_groups

@@ -71,14 +71,18 @@ class RaftStub:
         payload = node.serializer.encode_command(command)
         if node.is_leader(self.lane) or not self.forward:
             fut = node.submit(self.lane, payload)
-            # A MARKED refusal (leadership moved between our check and the
-            # node's) provably never entered the log: forwarding is safe.
-            # The marker is required — an accept-then-abort race can
-            # complete the future with an UNMARKED NotLeaderError for a
-            # command that may still commit (api/anomaly.py as_refusal).
+            # A MARKED refusal provably never entered the log, so retrying
+            # through the forward path is safe for every TRANSIENT kind —
+            # NotLeader (leadership moved between our check and the
+            # node's), NotReady (the fresh leader's majority-health gate
+            # hasn't opened yet; it lapses transiently right after an
+            # election), BusyLoop (queue pressure).  The marker is
+            # required — an accept-then-abort race can complete the future
+            # with an UNMARKED NotLeaderError for a command that may still
+            # commit (api/anomaly.py as_refusal).
             exc = fut.exception() if fut.done() else None
             if (self.forward and exc is not None and is_refusal(exc)
-                    and isinstance(exc, NotLeaderError)):
+                    and type(exc).__name__ in self._TRANSIENT_REFUSALS):
                 return self._forwarded(payload, timeout)
             return fut
         return self._forwarded(payload, timeout)

@@ -1,15 +1,18 @@
 from .types import (
     CANDIDATE, FOLLOWER, LEADER, NIL, PRE_CANDIDATE,
-    EngineConfig, HostInbox, LogState, Messages, RaftState, StepInfo,
-    init_state,
+    EngineConfig, FaultSchedule, HostInbox, LogState, Messages, RaftState,
+    StepInfo, crash_restart, init_state,
 )
 from .step import node_step, ring_term_at, ring_terms_batch, ring_write_batch
-from .cluster import DeviceCluster, cluster_step, route, auto_host_inbox
+from .cluster import (
+    DeviceCluster, auto_host_inbox, cluster_step, cluster_step_nemesis, route,
+)
 
 __all__ = [
     "CANDIDATE", "FOLLOWER", "LEADER", "NIL", "PRE_CANDIDATE",
     "EngineConfig", "HostInbox", "LogState", "Messages", "RaftState",
-    "StepInfo", "init_state", "node_step", "ring_term_at",
+    "StepInfo", "FaultSchedule", "crash_restart", "cluster_step_nemesis",
+    "init_state", "node_step", "ring_term_at",
     "ring_terms_batch", "ring_write_batch", "DeviceCluster", "cluster_step",
     "route", "auto_host_inbox",
 ]

@@ -27,11 +27,20 @@ import numpy as np
 
 from .step import node_step, ring_term_at
 from .types import (
-    LEADER, EngineConfig, HostInbox, Messages, RaftState, StepInfo, init_state,
+    LEADER, EngineConfig, FaultSchedule, HostInbox, Messages, RaftState,
+    StepInfo, crash_restart, init_state,
 )
 
 _VALID_FIELDS = tuple(f.name for f in dataclasses.fields(Messages)
                       if f.name.endswith("_valid"))
+# Message kinds (ae/aer/rv/rvr/is/isr) -> all fields of that RPC.  The
+# leading underscore token of a field name is its kind; the nemesis
+# duplicate-delivery merge replaces whole RPCs, so it must move every
+# field of a kind together (a dup'd AE with a fresh reply's term lanes
+# would be a frankenmessage).
+_KIND_FIELDS = {}
+for _f in dataclasses.fields(Messages):
+    _KIND_FIELDS.setdefault(_f.name.split("_", 1)[0], []).append(_f.name)
 
 
 def route(outboxes: Messages, conn: Optional[jax.Array] = None) -> Messages:
@@ -66,6 +75,89 @@ def cluster_step(cfg: EngineConfig, states: RaftState, inflight: Messages,
     inboxes = route(inflight, conn)
     new_states, outboxes, infos = jax.vmap(partial(node_step, cfg))(
         states, inboxes, host)
+    return new_states, outboxes, infos
+
+
+def _node_bcast(mask: jax.Array, like: jax.Array) -> jax.Array:
+    """Broadcast a [N] node mask against a leading-node-axis array."""
+    return mask.reshape(mask.shape + (1,) * (like.ndim - 1))
+
+
+def _select_nodes(mask: jax.Array, on_true, on_false):
+    """Per-node pytree select: leaf[n] <- on_true[n] where mask[n]."""
+    return jax.tree.map(
+        lambda a, b: jnp.where(_node_bcast(mask, a), a, b), on_true, on_false)
+
+
+def cluster_step_nemesis(cfg: EngineConfig, states: RaftState,
+                         inflight: Messages, host: HostInbox,
+                         prev_info: StepInfo, fault: FaultSchedule
+                         ) -> Tuple[RaftState, Messages, StepInfo]:
+    """One lockstep tick under one tick-slice of a :class:`FaultSchedule`.
+
+    ``fault`` holds the per-tick arrays (``link_up`` [N, N], ``crash`` [N],
+    ``stall`` [N], ``dup`` [N, N] — a scanned slice of the [T, ...]
+    schedule).  Order of operations (the fault model of
+    ``types.FaultSchedule``):
+
+    1. crashed nodes reset volatile state to the durable frontier
+       (:func:`crash_restart`) BEFORE delivery;
+    2. in-flight messages deliver through ``link_up``; anything addressed
+       to a crashed or stalled node is lost (it was down on arrival);
+    3. live nodes step; stalled nodes are frozen wholesale — state, clock,
+       timers, StepInfo — and send nothing;
+    4. messages delivered over a ``dup`` link this tick are queued again
+       for next tick unless the sender wrote a fresh RPC of the same kind
+       over the lane (at-least-once delivery, exercising stale/duplicate
+       RPC idempotency).
+
+    Not jitted standalone: the nemesis path is always driven through the
+    fused scan (core/sim.py ``run_cluster_ticks_nemesis``).
+    """
+    down = fault.crash | fault.stall                               # [N]
+
+    # 1. crash-restart.  crash_restart splits each node's PRNG key; the
+    # select keeps un-crashed nodes' streams bit-exact (types.py).
+    restarted = jax.vmap(partial(crash_restart, cfg))(states)
+    states = _select_nodes(fault.crash, restarted, states)
+
+    # 2. delivery: link masks AND down-destination loss.  After route()'s
+    # transpose conn[s, d] gates the s->d lane, so down destinations are
+    # a column mask.
+    inboxes = route(inflight, fault.link_up & ~down[None, :])
+
+    # 3. step, then freeze stalled nodes (their pre-step state INCLUDES a
+    # same-tick crash reset: a node both crashed and stalled restarts but
+    # does not run).  StepInfo freezes too, so the self-driving host inbox
+    # (auto_host_inbox snapshot echo) does not act for a stalled node.
+    stepped, outboxes, infos = jax.vmap(partial(node_step, cfg))(
+        states, inboxes, host)
+    new_states = _select_nodes(fault.stall, states, stepped)
+    infos = _select_nodes(fault.stall, prev_info, infos)
+    sender_up = ~fault.stall
+    outboxes = outboxes.replace(**{
+        name: getattr(outboxes, name) & _node_bcast(
+            sender_up, getattr(outboxes, name))
+        for name in _VALID_FIELDS})
+
+    # 4. duplicate delivery: re-queue this tick's DELIVERED messages on
+    # dup'd links, whole-RPC, wherever the fresh outbox left the lane
+    # empty.  The copy rides ``inflight`` and is subject to next tick's
+    # masks like any message.
+    delivered = fault.link_up & ~down[None, :]                     # [N, N]
+    dup_lane = (fault.dup & delivered)[:, :, None]                 # [N, N, 1]
+    reps = {}
+    for kind, names in _KIND_FIELDS.items():
+        vname = f"{kind}_valid"
+        keep = dup_lane & getattr(inflight, vname) \
+            & ~getattr(outboxes, vname)                            # [N, P, G]
+        for name in names:
+            old = getattr(inflight, name)
+            new = getattr(outboxes, name)
+            k = keep if old.ndim == keep.ndim else keep[..., None]
+            reps[name] = jnp.where(k, old, new)
+        reps[vname] = getattr(outboxes, vname) | keep
+    outboxes = outboxes.replace(**reps)
     return new_states, outboxes, infos
 
 
@@ -116,6 +208,25 @@ def auto_host_inbox(cfg: EngineConfig, states: RaftState, submit_n: jax.Array,
             snap_term=info.snap_req_term,
         )
     return jax.vmap(one)(states, submit_n, prev_info)
+
+
+def cluster_snapshot(states: RaftState) -> dict:
+    """Host snapshot dict from a stacked [N, ...] RaftState — the ONE
+    definition of the audit currency, shared by ``DeviceCluster.snapshot``
+    and the fused-scan audit paths (testkit/invariants.py ClusterChecker,
+    testkit/nemesis.py), so raw scan outputs audit without a DeviceCluster
+    wrapper and the two paths cannot drift."""
+    return {
+        "term": np.asarray(states.term),
+        "role": np.asarray(states.role),
+        "voted_for": np.asarray(states.voted_for),
+        "leader_id": np.asarray(states.leader_id),
+        "commit": np.asarray(states.commit),
+        "last": np.asarray(states.log.last),
+        "base": np.asarray(states.log.base),
+        "log_term": np.asarray(states.log.term),
+        "now": np.asarray(states.now),
+    }
 
 
 class DeviceCluster:
@@ -210,17 +321,7 @@ class DeviceCluster:
     # -- inspection ---------------------------------------------------------
     def snapshot(self) -> dict:
         """Pull the whole cluster state to host numpy for assertions."""
-        return {
-            "term": np.asarray(self.states.term),
-            "role": np.asarray(self.states.role),
-            "voted_for": np.asarray(self.states.voted_for),
-            "leader_id": np.asarray(self.states.leader_id),
-            "commit": np.asarray(self.states.commit),
-            "last": np.asarray(self.states.log.last),
-            "base": np.asarray(self.states.log.base),
-            "log_term": np.asarray(self.states.log.term),
-            "now": np.asarray(self.states.now),
-        }
+        return cluster_snapshot(self.states)
 
     def leaders(self, group: int = 0) -> list[int]:
         role = np.asarray(self.states.role[:, group])

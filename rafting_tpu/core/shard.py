@@ -21,7 +21,9 @@ from typing import Tuple
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
 
-from .types import EngineConfig, LogState, Messages, RaftState, StepInfo
+from .types import (
+    EngineConfig, FaultSchedule, LogState, Messages, RaftState, StepInfo,
+)
 
 # RaftState fields with no group axis: per-node scalars and the PRNG key.
 _STATE_NODE_ONLY = ("node_id", "now", "rng")
@@ -55,6 +57,31 @@ def info_pspecs() -> StepInfo:
 # Non-pytree cluster inputs.
 CONN_PSPEC = PS("node")        # [N, N] connectivity — rows ride the node axis
 SUBMIT_PSPEC = PS("node", "group")  # [N, G] offered load
+
+
+def fault_schedule_pspecs() -> FaultSchedule:
+    """Specs for a [T, ...] FaultSchedule: the tick axis is scanned (never
+    sharded); the first NODE axis rides the mesh's node dimension, exactly
+    like CONN_PSPEC's rows — so each device holds its own node's fault
+    lanes and the scan consumes them without cross-chip gathers."""
+    return FaultSchedule(
+        link_up=PS(None, "node"),   # [T, N, N] — sender rows per device
+        crash=PS(None, "node"),     # [T, N]
+        stall=PS(None, "node"),     # [T, N]
+        dup=PS(None, "node"),       # [T, N, N]
+    )
+
+
+def shard_fault_schedule(mesh: Mesh, sched: FaultSchedule) -> FaultSchedule:
+    """device_put a fault schedule with its per-field specs (the nemesis
+    analog of :func:`shard_cluster`)."""
+    T, N = sched.crash.shape
+    assert sched.link_up.shape == (T, N, N), sched.link_up.shape
+    assert sched.stall.shape == (T, N), sched.stall.shape
+    assert sched.dup.shape == (T, N, N), sched.dup.shape
+    return jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+        sched, fault_schedule_pspecs())
 
 
 def validate_cluster_shapes(cfg: EngineConfig, states: RaftState,

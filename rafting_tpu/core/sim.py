@@ -23,9 +23,9 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from .cluster import auto_host_inbox, cluster_step
+from .cluster import auto_host_inbox, cluster_step, cluster_step_nemesis
 from .shard import info_pspecs, messages_pspecs, state_pspecs, SUBMIT_PSPEC
-from .types import EngineConfig, Messages, RaftState, StepInfo
+from .types import EngineConfig, FaultSchedule, Messages, RaftState, StepInfo
 
 
 def _scan_ticks(cfg: EngineConfig, n_ticks: int, states: RaftState,
@@ -57,6 +57,41 @@ def run_cluster_ticks(cfg: EngineConfig, n_ticks: int, states: RaftState,
     """
     return _scan_ticks(cfg, n_ticks, states, inflight, prev_info, conn,
                        submit_n)
+
+
+@partial(jax.jit, static_argnums=0, donate_argnums=(1, 2, 3))
+def run_cluster_ticks_nemesis(cfg: EngineConfig, states: RaftState,
+                              inflight: Messages, prev_info: StepInfo,
+                              sched: FaultSchedule, submit_n: jax.Array
+                              ) -> Tuple[RaftState, Messages, StepInfo]:
+    """Advance the cluster ``sched.n_ticks`` ticks under a fault schedule.
+
+    The device-side nemesis: the whole chaos scenario — per-tick directed
+    link masks, crash-restarts, clock stalls, duplicate deliveries — is
+    data riding ``lax.scan`` as scan inputs, so the run executes inside
+    ONE compiled program with zero per-tick host round-trips (the
+    requirement that lets chaos run at the benchmark's 10k-100k-group
+    scale instead of `DeviceCluster.tick`'s host-loop pace).  Tick count
+    comes from the schedule's leading axis.  Fully deterministic: same
+    seed + same schedule replays bit-identically (every lane is integer /
+    counter-mode PRNG — there is no order-dependent float math to drift).
+
+    ``submit_n`` is [N, G] constant offered load, as in
+    :func:`run_cluster_ticks`; the self-driving host policy
+    (``auto_host_inbox``: slack compaction + instant snapshot service) is
+    folded into the scan body, with a stalled node's StepInfo frozen so
+    its host half stalls with it.
+    """
+    def body(carry, fault):
+        states, inflight, info = carry
+        host = auto_host_inbox(cfg, states, submit_n, True, info)
+        states, inflight, info = cluster_step_nemesis(
+            cfg, states, inflight, host, info, fault)
+        return (states, inflight, info), ()
+
+    (states, inflight, info), _ = jax.lax.scan(
+        body, (states, inflight, prev_info), sched)
+    return states, inflight, info
 
 
 def _group_axis(spec) -> int | None:

@@ -194,6 +194,104 @@ class RaftState:
 
 
 @struct.dataclass
+class FaultSchedule:
+    """A precomputed, device-resident fault plan for a fused chaos run.
+
+    The "nemesis" plane (Jepsen terminology): every array is indexed by
+    tick along its leading axis, so a whole chaos scenario — partitions,
+    asymmetric/flaky links, crash-restarts, clock stalls, duplicate
+    deliveries — rides through ``lax.scan`` (core/sim.py
+    ``run_cluster_ticks_nemesis``) as scan inputs and the entire run
+    executes inside ONE compiled program.  This is the vectorized analog
+    of the reference's manual chaos procedure (kill TCP links / kill -9 a
+    JVM / restart, README.md:28-33), but deterministic: the schedule is
+    data, so the same seed replays bit-identically.
+
+    Semantics per tick t (applied by the nemesis scan body):
+
+    * ``link_up[t, s, d]`` False — messages in flight s->d are dropped at
+      delivery (directed: asymmetric links are expressible).
+    * ``crash[t, n]`` — node n crash-restarts BEFORE delivery: volatile
+      state resets to the durable frontier (:func:`crash_restart`, the
+      in-scan mirror of ``log/store.py restore_raft_state``), messages
+      addressed to it this tick are lost (it was down when they arrived).
+    * ``stall[t, n]`` — node n is frozen this tick (GC pause / clock
+      stall): its step does not run, its clock and timers do not advance,
+      it sends nothing, and inbound messages are lost.  Per-node ``now``
+      clocks drift apart under stalls — by design; every timer in the
+      kernel is anchored to the node's OWN clock.
+    * ``dup[t, s, d]`` — every message delivered over s->d this tick is
+      ALSO re-delivered next tick (unless a fresh message overwrites the
+      lane), exercising duplicate/stale-RPC idempotency.
+    """
+
+    link_up: jax.Array  # [T, N, N] bool — conn[s, d] per tick (False = cut)
+    crash: jax.Array    # [T, N] bool — crash-restart node n at tick t
+    stall: jax.Array    # [T, N] bool — freeze node n for tick t
+    dup: jax.Array      # [T, N, N] bool — duplicate deliveries on link s->d
+
+    @property
+    def n_ticks(self) -> int:
+        return self.link_up.shape[0]
+
+    @classmethod
+    def healthy(cls, n_peers: int, n_ticks: int) -> "FaultSchedule":
+        """The no-fault schedule: all links up, nothing crashes."""
+        return cls(
+            link_up=jnp.ones((n_ticks, n_peers, n_peers), jnp.bool_),
+            crash=jnp.zeros((n_ticks, n_peers), jnp.bool_),
+            stall=jnp.zeros((n_ticks, n_peers), jnp.bool_),
+            dup=jnp.zeros((n_ticks, n_peers, n_peers), jnp.bool_),
+        )
+
+
+def crash_restart(cfg: EngineConfig, s: "RaftState") -> "RaftState":
+    """Volatile-state reset for an in-scan crash-restart of ONE node.
+
+    Mirrors the host recovery path exactly (``log/store.py
+    restore_raft_state`` + ``runtime/node.py`` boot): durable state —
+    ``term``, ``voted_for`` and the log (ring / base / base_term / last)
+    — survives (the WAL persists stable records and entries before any
+    RPC leaves the node); everything else is volatile.  ``commit``
+    restarts at the compaction floor (entries at/below the milestone are
+    committed by definition; the rest is rediscovered from leaderCommit
+    traffic), leadership bookkeeping resets to boot values, and the
+    election timer re-arms with a fresh randomized window like a reboot.
+    The PRNG key is split ONLY on the crash path (callers select with the
+    crash mask), so un-crashed nodes keep their stream bit-exactly.
+    """
+    G, P = cfg.n_groups, cfg.n_peers
+    rng, k = jax.random.split(s.rng)
+    deadline = s.now + jax.random.randint(
+        k, (G,), cfg.election_ticks, 2 * cfg.election_ticks, dtype=I32)
+    z = lambda *sh: jnp.zeros(sh, I32)
+    f = lambda *sh: jnp.zeros(sh, jnp.bool_)
+    boot_next = jnp.broadcast_to(s.log.last[:, None] + 1, (G, P))
+    return s.replace(
+        rng=rng,
+        role=z(G),
+        leader_id=jnp.full((G,), NIL, I32),
+        commit=s.log.base,
+        applied=z(G),
+        own_from=z(G),
+        next_idx=boot_next,
+        match_idx=z(G, P),
+        send_next=boot_next,
+        inflight=z(G, P),
+        hb_inflight=z(G, P),
+        sent_at=z(G, P),
+        need_snap=f(G, P),
+        ok_at=z(G, P),
+        fail_at=z(G, P),
+        fail_streak=z(G, P),
+        votes=f(G, P),
+        prevotes=f(G, P),
+        elect_deadline=deadline,
+        hb_due=z(G),
+    )
+
+
+@struct.dataclass
 class Messages:
     """One tick's worth of RPC traffic, dense over (peer, group).
 

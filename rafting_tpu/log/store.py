@@ -52,6 +52,47 @@ class LogStore:
 
     # -- the run cache -------------------------------------------------------
 
+    # Trim re-materialization thresholds: a milestone/truncate trim slices
+    # a run's offs/lens but keeps ``buf`` — which may be (a view into) a
+    # whole 64MB MSGS frame or staging arena.  When the surviving entries
+    # cover under 1/_COMPACT_RATIO of the pinned frame and the frame is
+    # big enough to matter, the remainder is copied into a compact buffer
+    # so one cached entry can no longer pin a frame-sized allocation
+    # (ADVICE r5: resident-memory inflation at 100k groups with mixed
+    # progress).
+    _COMPACT_MIN_FRAME = 1 << 16
+    _COMPACT_RATIO = 4
+
+    @staticmethod
+    def _frame_bytes(buf) -> int:
+        """True pinned size: a memoryview keeps its WHOLE exporter alive,
+        so the slice length understates what the cache is holding."""
+        if isinstance(buf, memoryview):
+            base = buf.obj
+            if base is not None:
+                try:
+                    return memoryview(base).nbytes
+                except TypeError:
+                    pass
+            return buf.nbytes
+        return len(buf)
+
+    @classmethod
+    def _maybe_compact(cls, run: PayloadRun) -> PayloadRun:
+        """Re-materialize a trimmed run into a compact private buffer when
+        it covers a small fraction of the frame it pins."""
+        n = len(run.lens)
+        if not n:
+            return run
+        frame = cls._frame_bytes(run.buf)
+        if frame < cls._COMPACT_MIN_FRAME:
+            return run
+        live = int(run.offs[n - 1]) + int(run.lens[n - 1]) - int(run.offs[0])
+        if live * cls._COMPACT_RATIO >= frame:
+            return run
+        return PayloadRun(run.start, bytes(run.piece(0, n)),
+                          run.offs - run.offs[0], run.lens)
+
     def _add_run(self, g: int, run: PayloadRun) -> None:
         """Insert a freshly written run (overwrite semantics: any cached
         entry at >= run.start dies first, mirroring the WAL's replay)."""
@@ -200,8 +241,9 @@ class LogStore:
                 if runs and runs[-1].end > tail:
                     r = runs[-1]
                     keep = tail - r.start + 1
-                    runs[-1] = PayloadRun(r.start, r.buf, r.offs[:keep],
-                                          r.lens[:keep])
+                    runs[-1] = self._maybe_compact(
+                        PayloadRun(r.start, r.buf, r.offs[:keep],
+                                   r.lens[:keep]))
 
     def put_stable(self, g: int, term: int, ballot: int) -> None:
         if self._stable.get(g) == (term, ballot):
@@ -226,8 +268,8 @@ class LogStore:
             if runs and runs[0].start <= index:
                 r = runs[0]
                 k = index + 1 - r.start
-                runs[0] = PayloadRun(index + 1, r.buf, r.offs[k:],
-                                     r.lens[k:])
+                runs[0] = self._maybe_compact(
+                    PayloadRun(index + 1, r.buf, r.offs[k:], r.lens[k:]))
                 starts[0] = index + 1
         self._durable_tail[g] = max(self._durable_tail.get(g, 0), index)
 

@@ -93,6 +93,15 @@ class ApplyDispatcher:
         self._promises: Dict[int, List[_Range]] = {}
         self._on_applied = on_applied
         self._retry_counts: Dict[tuple, int] = {}
+        # Empty-payload (election no-op) guard: machines that do not set
+        # ``applies_empty = True`` (machine/spi.py) never see empty
+        # payloads — the dispatcher skips them and records the highest
+        # skipped index per group here, so the apply frontier keeps
+        # advancing past no-ops the machine's own last_applied cannot
+        # cover.  Invariant: _skip_hi[g], when present, is an index the
+        # dispatcher fully processed (applied or skipped) up to.
+        self._skip_hi: Dict[int, int] = {}
+        self._warned_empty: set = set()
         # Numpy mirror of every machine's last_applied: advance() visits
         # only lanes whose commit frontier moved past it, so per-tick cost
         # scales with progress, not with total group count (VERDICT r1 #8).
@@ -232,6 +241,7 @@ class ApplyDispatcher:
         if m is not None:
             (m.destroy if destroy else m.close)()
         self._halted.pop(g, None)
+        self._skip_hi.pop(g, None)
         if self._applied_arr is not None and g < len(self._applied_arr):
             self._applied_arr[g] = 0
         for key in [k for k in self._retry_counts if k[0] == g]:
@@ -247,6 +257,8 @@ class ApplyDispatcher:
         self.machine(g).recover(checkpoint)
         if self._applied_arr is not None and g < len(self._applied_arr):
             self._applied_arr[g] = self.machine(g).last_applied()
+        if self._skip_hi.get(g, 0) <= checkpoint.index:
+            self._skip_hi.pop(g, None)
         self._fail_span(g, 0, checkpoint.index, RuntimeError(
             "entry applied via snapshot; result unavailable"))
         self._halted[g] = False
@@ -274,9 +286,17 @@ class ApplyDispatcher:
                 continue
             m = self.machine(g)
             apply_fn = m.apply
+            applies_empty = bool(getattr(m, "applies_empty", False))
             has_promises = g in self._promises
             target = int(commit[g])
             before = m.last_applied()
+            if not applies_empty:
+                # Resume past no-ops this machine never saw (spi.py
+                # empty-payload opt-out): the dispatcher's skip ledger
+                # extends the machine's own frontier.
+                sk = self._skip_hi.get(g, 0)
+                if sk > before:
+                    before = sk
             idx = before + 1
             hi = target if max_per_group <= 0 \
                 else min(target, idx + max_per_group - 1)
@@ -295,6 +315,12 @@ class ApplyDispatcher:
             if probe_ok and run_fn is not None \
                     and self._payload_runs is not None:
                 pr = self._payload_runs(g, idx, hi - idx + 1)
+                if pr is not None and not applies_empty \
+                        and (np.asarray(pr[1]) == 0).any():
+                    # Window holds an election no-op the machine must not
+                    # see: route through the windowed/per-entry paths,
+                    # which skip it (spi.py applies_empty contract).
+                    pr = None
                 if pr is not None:
                     try:
                         results = run_fn(idx, pr[0], pr[1])
@@ -318,7 +344,10 @@ class ApplyDispatcher:
                 if window is not None and batch_fn is not None:
                     n_have = 0
                     for p in window:
-                        if p is None:
+                        # Stop the batch at an election no-op the machine
+                        # opted out of seeing; the per-entry loop below
+                        # skips it and carries on.
+                        if p is None or (not p and not applies_empty):
                             break
                         n_have += 1
                     if n_have:
@@ -366,6 +395,27 @@ class ApplyDispatcher:
                     # committed via snapshot milestone); the machine must
                     # catch up via recover, not apply.
                     break
+                if not payload and not applies_empty:
+                    # Election no-op (Raft §8) short-circuited for a
+                    # machine without the spi.py opt-in: the machine never
+                    # sees the empty command, the dispatcher's skip ledger
+                    # carries the frontier over it, and any (unusual)
+                    # client promise on an empty command completes None.
+                    key = type(m).__name__
+                    if key not in self._warned_empty:
+                        self._warned_empty.add(key)
+                        log.warning(
+                            "machine %s (group %d) does not opt into "
+                            "empty-payload applies (applies_empty=False); "
+                            "short-circuiting election no-op at index %d "
+                            "— set applies_empty=True on the machine to "
+                            "receive empty commands (machine/spi.py)",
+                            key, g, idx)
+                    if has_promises:
+                        self._complete_run(g, idx, [None])
+                    self._skip_hi[g] = idx
+                    idx += 1
+                    continue
                 try:
                     result = apply_fn(idx, payload)
                 except Exception as e:

@@ -21,6 +21,10 @@ from .spi import Checkpoint
 
 
 class FileMachine:
+    # Opt into election no-ops (machine/spi.py): an empty command appends
+    # an 'index:' line, keeping replica files byte-identical incl. no-ops.
+    applies_empty = True
+
     def __init__(self, path: str):
         self.path = path
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)

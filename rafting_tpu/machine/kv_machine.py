@@ -18,6 +18,8 @@ from .spi import Checkpoint
 class KVMachine:
     """Commands: JSON bytes {"op": "set"|"del", "k": str, "v": any}."""
 
+    applies_empty = True   # election no-ops advance last_applied, no-op op
+
     def __init__(self, path: str):
         self.path = path
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)

@@ -23,10 +23,20 @@ class RaftMachine(Protocol):
       order, starting at ``last_applied() + 1``.  It must be atomic: apply
       fully or raise (a raise halts the group's apply frontier; the
       dispatcher retries later — reference RetryCommandException semantics,
-      support/anomaly/RetryCommandException.java:10-25).  Payloads may be
-      EMPTY (``b""``): a freshly elected leader appends one empty no-op
-      entry (Raft §8 liveness, core/step.py phase 3) — machines must
-      treat it as a harmless command (apply it, return anything).
+      support/anomaly/RetryCommandException.java:10-25).
+    * ``applies_empty`` (class attribute, default False): committed
+      payloads may be EMPTY (``b""``) — a freshly elected leader appends
+      one empty no-op entry (Raft §8 liveness, core/step.py phase 3).  A
+      machine that sets ``applies_empty = True`` opts into seeing them
+      (apply it as a harmless command, return anything) and keeps the
+      strictly contiguous index stream.  WITHOUT the opt-in the
+      dispatcher short-circuits empty payloads — the machine never sees
+      them, its ``last_applied`` may lag the group frontier by trailing
+      no-ops, and the index stream it observes has gaps at election
+      no-ops (still strictly increasing).  This protects third-party
+      machines that unconditionally parse payloads (e.g. ``json.loads``)
+      from freezing their group on every election; the dispatcher logs
+      loudly (once) when it engages.  Every in-tree machine opts in.
     * :meth:`checkpoint` produces a durable snapshot whose index is at
       least ``must_include`` (may block; called off the apply path).
     * :meth:`recover` atomically replaces state from a checkpoint.
@@ -51,6 +61,8 @@ class RaftMachine(Protocol):
       contract as ``apply_batch``, and the same caution about
       overriding ``apply``.
     """
+
+    applies_empty: bool = False
 
     def last_applied(self) -> int: ...
 

@@ -44,13 +44,30 @@ LANES = 128
 
 def quorum_commit_ref(match_full: jax.Array, own_from, last, commit,
                       can_lead, majority: int) -> jax.Array:
-    """Pure-jnp reference (exactly core/step.py phase 10)."""
+    """Pure-jnp reference (exactly core/step.py phase 10).
+
+    Two commit lanes, exactly the reference's tryCommit
+    (Leader.java:256-261):
+
+    * quorum lane — the majority order statistic, gated by the
+      commit-only-own-term rule (``quorum_idx >= own_from``);
+    * full-replication lane — the MINIMUM of the match row
+      (Leader.java:260 ``fullIndex``): an entry replicated on EVERY node
+      is identical on every node up to that index (matchIndex semantics),
+      so any electable future leader already holds it — committing it
+      needs no own-term fence.  This is what lets a fully-replicated
+      prior-term suffix commit on a ring-full lane where the §8 no-op
+      could not be appended (core/step.py phase 3 skips it at capacity).
+    """
     P = match_full.shape[1]
     sorted_m = jnp.sort(match_full, axis=1)
     quorum_idx = sorted_m[:, P - majority]
+    full_idx = sorted_m[:, 0]
     can = can_lead & (quorum_idx > commit) & \
         (quorum_idx >= own_from) & (quorum_idx <= last)
-    return jnp.where(can, quorum_idx, commit)
+    can_full = can_lead & (full_idx > commit) & (full_idx <= last)
+    return jnp.maximum(jnp.where(can, quorum_idx, commit),
+                       jnp.where(can_full, full_idx, commit))
 
 
 # ------------------------------------------------------------------- kernel --
@@ -72,11 +89,15 @@ def _kernel(P: int, majority: int,
             hi = jnp.maximum(planes[i], planes[i + 1])
             planes[i], planes[i + 1] = lo, hi
     q = planes[P - majority]
+    full = planes[0]   # minimum of the match row: the full-replication lane
 
     commit = commit_ref[...]
-    can = ((lead_ref[...] != 0) & (q > commit)
-           & (q >= own_from_ref[...]) & (q <= last_ref[...]))
-    out_ref[...] = jnp.where(can, q, commit)
+    last = last_ref[...]
+    lead = lead_ref[...] != 0
+    can = lead & (q > commit) & (q >= own_from_ref[...]) & (q <= last)
+    can_full = lead & (full > commit) & (full <= last)
+    out_ref[...] = jnp.maximum(jnp.where(can, q, commit),
+                               jnp.where(can_full, full, commit))
 
 
 def _pad_rows(a: np.ndarray | jax.Array, G: int, Gp: int, fill=0):
@@ -151,9 +172,16 @@ def quorum_commit(cfg, match_full, log, commit, own_from, can_lead):
         a, b, c = match_full[:, 0], match_full[:, 1], match_full[:, 2]
         quorum_idx = jnp.maximum(jnp.minimum(a, b),
                                  jnp.minimum(jnp.maximum(a, b), c))
+        full_idx = jnp.minimum(jnp.minimum(a, b), c)
     else:
         sorted_m = jnp.sort(match_full, axis=1)
         quorum_idx = sorted_m[:, P - cfg.majority]
+        full_idx = sorted_m[:, 0]
     can = can_lead & (quorum_idx > commit) & \
         (quorum_idx >= own_from) & (quorum_idx <= log.last)
-    return jnp.where(can, quorum_idx, commit)
+    # Full-replication lane (reference Leader.java:260): min of the match
+    # row commits with NO own-term fence — an all-nodes-replicated prefix
+    # is on every future leader's log by construction.
+    can_full = can_lead & (full_idx > commit) & (full_idx <= log.last)
+    return jnp.maximum(jnp.where(can, quorum_idx, commit),
+                       jnp.where(can_full, full_idx, commit))

@@ -890,14 +890,23 @@ class RaftNode:
                     spans.append((g, start_idx, b.run.piece(k0, take),
                                   b.run.lens[k0:k0 + take], term_g))
             elif n_sub:
-                # Adoption gap ahead of the submission range (possible
-                # only in the adopt-then-elect-then-accept corner): the
-                # entries are accepted on device, so promises must still
-                # register; staging is skipped to keep the durable prefix
-                # contiguous (resend repairs, then truncation-mirror
-                # reconciles).
-                for start_idx, b, k0, take in own_by_g.get(g, ()):
-                    reg_range(g, start_idx, take, b.sink, k0)
+                # Adoption gap ahead of a same-tick submission range:
+                # unreachable by kernel phase order, asserted like the
+                # queue-depth invariant above (ADVICE r5).  Reaching here
+                # needs one tick to BOTH adopt follower entries (phase 4,
+                # gated role != LEADER after the phase-3 election update)
+                # AND accept own submissions (phase 8, requires LEADER) —
+                # and the only promotions between those phases (phase 7
+                # timers) stop at CANDIDATE.  Were it ever reached,
+                # registering promises without staging payloads would
+                # leave the accepted entries durable nowhere: pack_slice
+                # drops their AE columns forever and the group wedges
+                # with hung futures — fail loudly instead.
+                raise AssertionError(
+                    f"g={g}: adoption gap [{lo}, {adopt_hi}] ahead of "
+                    f"device-accepted own submissions at {sub_lo} — "
+                    "kernel phase order makes adopt+accept in one tick "
+                    "impossible")
         if spans:
             append_spans = getattr(self.store, "append_spans", None)
             if append_spans is not None:

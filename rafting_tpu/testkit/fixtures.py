@@ -16,6 +16,8 @@ from ..machine.spi import Checkpoint, MachineProvider, RaftMachine
 
 
 class NullMachine(RaftMachine):
+    applies_empty = True   # counts no-ops like any apply
+
     def __init__(self):
         self._applied = 0
 

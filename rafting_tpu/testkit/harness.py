@@ -146,6 +146,46 @@ class LocalCluster:
             self.tick()
         raise AssertionError(f"{what} not reached in {max_rounds} rounds")
 
+    def replay_schedule(self, sched, audit: Optional[Callable[[int], None]]
+                        = None) -> None:
+        """Host-path nemesis parity: drive the SAME FaultSchedule the
+        fused device scan consumes (core/sim.py run_cluster_ticks_nemesis)
+        against the full event-loop runtime — real RaftNodes, WAL, state
+        machines, codec round-trips over the loopback network.  Tick t:
+
+        * ``crash[t, n]``  -> kill_node + restart_node (rebuild from WAL:
+          the host mirror of the engine's in-scan ``crash_restart``);
+        * ``link_up[t]``   -> bulk connectivity matrix on the network;
+        * ``dup[t]``       -> duplicate-delivery links on the network;
+        * ``stall[t, n]``  -> node n simply does not tick (its engine
+          clock, timers and sends all freeze, like the device stall).
+
+        ``audit(t)`` runs after every tick (invariant checks, snapshots).
+        Used for CPU/TPU cross-validation: the same seed's schedule must
+        keep both the vectorized and the event-loop paths safe.
+        """
+        import numpy as np
+        link = np.asarray(sched.link_up)
+        crash = np.asarray(sched.crash)
+        stall = np.asarray(sched.stall)
+        dup = np.asarray(sched.dup)
+        try:
+            for t in range(link.shape[0]):
+                for n in np.nonzero(crash[t])[0].tolist():
+                    if n in self.nodes:
+                        self.kill_node(int(n))
+                        self.restart_node(int(n))
+                self.net.set_conn(link[t])
+                self.net.set_dup(dup[t])
+                for i, node in list(self.nodes.items()):
+                    if not stall[t, i]:
+                        node.tick()
+                if audit is not None:
+                    audit(t)
+        finally:
+            self.net.heal()
+            self.net.set_dup(np.zeros((self.cfg.n_peers,) * 2, bool))
+
     # -- queries -------------------------------------------------------------
 
     def leader_of(self, group: int) -> Optional[int]:

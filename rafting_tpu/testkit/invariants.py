@@ -27,6 +27,7 @@ from typing import Dict, Tuple
 
 import numpy as np
 
+from ..core.cluster import cluster_snapshot  # noqa: F401 — audit currency
 from ..core.types import LEADER
 
 
@@ -47,8 +48,17 @@ class ClusterChecker:
         self.max_commit = None   # [N, G] per-node committed frontier
         self.max_term = None     # [N, G]
 
-    def check(self, snap: dict) -> None:
-        """snap: dict of numpy arrays from DeviceCluster.snapshot()."""
+    def check(self, snap: dict, crashed=None) -> None:
+        """snap: dict of numpy arrays from DeviceCluster.snapshot().
+
+        ``crashed``: optional [N] bool — nodes that crash-restarted since
+        the previous check (nemesis runs).  commitIndex is VOLATILE in
+        Raft (rediscovered from leaderCommit; the engine restarts it at
+        the compaction floor), so a crashed node's per-node frontier may
+        legally regress — its monotonicity baseline resets.  Everything
+        durable (term, log, the global committed-entry ledger) stays
+        strict: a crash excuses no safety property.
+        """
         role, term = snap["role"], snap["term"]
         commit, last = snap["commit"], snap["last"]
         base, log_term = snap["base"], snap["log_term"]
@@ -83,7 +93,11 @@ class ClusterChecker:
                     f"two leaders for group {g} term {term[n, g]}: "
                     f"nodes {prev} and {n}")
 
-        # Commit stability: frontier never regresses.
+        # Commit stability: frontier never regresses — except on a node
+        # that crash-restarted, whose volatile commit restarts at its
+        # compaction floor.
+        if self.max_commit is not None and crashed is not None:
+            self.max_commit[np.asarray(crashed, bool)] = 0
         if self.max_commit is not None and (commit < self.max_commit).any():
             n, g = np.argwhere(commit < self.max_commit)[0]
             raise InvariantViolation(

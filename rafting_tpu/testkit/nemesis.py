@@ -1,0 +1,302 @@
+"""Nemesis schedule generators + audited device chaos runs.
+
+The fault-injection *plane* lives in the engine (core/types.py
+``FaultSchedule``, core/sim.py ``run_cluster_ticks_nemesis``); this module
+is the *policy* tier: seeded generators that compile whole Jepsen-style
+scenarios — split brain, rolling partitions, crash-restart storms, lossy/
+duplicating links, clock stalls — into the dense per-tick schedule arrays,
+plus the audit harness that runs a schedule on device in fused windows and
+checks every Raft safety invariant between windows (testkit/invariants.py
+``ClusterChecker``).
+
+Everything is a pure function of ``(shape, seed)``: the same seed produces
+the same schedule, and the engine run itself is bit-deterministic (integer
+lanes + counter-mode PRNG only), so a failing chaos run replays exactly —
+``assert_nemesis_deterministic`` pins that property.  This is the
+vectorized, reproducible analog of the reference's manual chaos procedure
+(kill TCP links / kill -9 a JVM / restart, README.md:28-33).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..core.types import EngineConfig, FaultSchedule
+
+__all__ = [
+    "healthy", "split_brain", "rolling_partition", "crash_storm",
+    "clock_stalls", "lossy_links", "compose", "concat", "chaos_mix",
+    "run_nemesis_audited", "assert_nemesis_deterministic",
+]
+
+
+def _as_schedule(link_up, crash, stall, dup) -> FaultSchedule:
+    import jax.numpy as jnp
+    return FaultSchedule(
+        link_up=jnp.asarray(link_up, jnp.bool_),
+        crash=jnp.asarray(crash, jnp.bool_),
+        stall=jnp.asarray(stall, jnp.bool_),
+        dup=jnp.asarray(dup, jnp.bool_),
+    )
+
+
+def _blank(n_peers: int, n_ticks: int):
+    """Host-side (numpy) all-healthy arrays for generators to mutate."""
+    return (np.ones((n_ticks, n_peers, n_peers), bool),
+            np.zeros((n_ticks, n_peers), bool),
+            np.zeros((n_ticks, n_peers), bool),
+            np.zeros((n_ticks, n_peers, n_peers), bool))
+
+
+def healthy(n_peers: int, n_ticks: int) -> FaultSchedule:
+    """All links up, nothing crashes (delegates to the engine's own
+    FaultSchedule.healthy so the two can never drift)."""
+    return FaultSchedule.healthy(n_peers, n_ticks)
+
+
+def split_brain(n_peers: int, n_ticks: int, *, start: int = 0,
+                stop: Optional[int] = None,
+                sides: Optional[Sequence[Sequence[int]]] = None,
+                seed: int = 0) -> FaultSchedule:
+    """Partition the cluster into ``sides`` for ticks [start, stop).
+
+    Default sides: a random near-half split drawn from ``seed`` (a
+    majority side must exist for progress; a seeded permutation keeps the
+    scenario reproducible).  Nodes can only reach their own side — the
+    classic split-brain window, healed for the remaining ticks.
+    """
+    link_up, crash, stall, dup = _blank(n_peers, n_ticks)
+    stop = n_ticks if stop is None else stop
+    if sides is None:
+        perm = np.random.default_rng(seed).permutation(n_peers)
+        k = n_peers // 2
+        sides = [perm[:k].tolist(), perm[k:].tolist()]
+    conn = np.zeros((n_peers, n_peers), bool)
+    for side in sides:
+        for a in side:
+            for b in side:
+                conn[a, b] = True
+    link_up[start:stop] = conn
+    return _as_schedule(link_up, crash, stall, dup)
+
+
+def rolling_partition(n_peers: int, n_ticks: int, *, period: int = 20,
+                      heal_gap: int = 5) -> FaultSchedule:
+    """Isolate each node in turn: node (w % N) is cut off for the first
+    ``period - heal_gap`` ticks of window w, then the cluster heals for
+    ``heal_gap`` ticks before the next victim — randomized-leader-churn
+    pressure without ever losing a quorum (BASELINE config-4's
+    "randomized leader churn" regime)."""
+    link_up, crash, stall, dup = _blank(n_peers, n_ticks)
+    for t in range(n_ticks):
+        w, off = divmod(t, period)
+        if off < period - heal_gap:
+            victim = w % n_peers
+            link_up[t, victim, :] = False
+            link_up[t, :, victim] = False
+            link_up[t, victim, victim] = True
+    return _as_schedule(link_up, crash, stall, dup)
+
+
+def crash_storm(n_peers: int, n_ticks: int, *, rate: float = 0.02,
+                seed: int = 0, max_down: Optional[int] = None
+                ) -> FaultSchedule:
+    """Random crash-restarts: each (tick, node) crashes with probability
+    ``rate``.  ``max_down`` caps simultaneous crashes per tick (default:
+    keep a majority standing, so liveness assertions stay meaningful —
+    safety must of course hold under ANY schedule)."""
+    link_up, crash, stall, dup = _blank(n_peers, n_ticks)
+    rng = np.random.default_rng(seed)
+    cap = (n_peers - (n_peers // 2 + 1)) if max_down is None else max_down
+    hits = rng.random((n_ticks, n_peers)) < rate
+    for t in range(n_ticks):
+        idx = np.nonzero(hits[t])[0]
+        if cap >= 0 and len(idx) > cap:
+            idx = rng.permutation(idx)[:cap]
+        crash[t, idx] = True
+    return _as_schedule(link_up, crash, stall, dup)
+
+
+def clock_stalls(n_peers: int, n_ticks: int, *, rate: float = 0.01,
+                 max_len: int = 8, seed: int = 0) -> FaultSchedule:
+    """GC-pause regime: nodes freeze for random windows of 1..max_len
+    ticks (clock, timers, sends and receives all stop — per-node clocks
+    drift apart, by design)."""
+    link_up, crash, stall, dup = _blank(n_peers, n_ticks)
+    rng = np.random.default_rng(seed)
+    for n in range(n_peers):
+        t = 0
+        while t < n_ticks:
+            if rng.random() < rate:
+                ln = int(rng.integers(1, max_len + 1))
+                stall[t:t + ln, n] = True
+                t += ln
+            else:
+                t += 1
+    return _as_schedule(link_up, crash, stall, dup)
+
+
+def lossy_links(n_peers: int, n_ticks: int, *, drop_p: float = 0.1,
+                dup_p: float = 0.0, seed: int = 0) -> FaultSchedule:
+    """Flaky network: every directed link independently drops each tick
+    with ``drop_p`` (asymmetric by construction) and duplicates delivered
+    traffic with ``dup_p``.  Self-links never drop."""
+    link_up, crash, stall, dup = _blank(n_peers, n_ticks)
+    rng = np.random.default_rng(seed)
+    link_up &= rng.random(link_up.shape) >= drop_p
+    if dup_p > 0:
+        dup |= rng.random(dup.shape) < dup_p
+    eye = np.eye(n_peers, dtype=bool)
+    link_up |= eye[None]
+    return _as_schedule(link_up, crash, stall, dup)
+
+
+def compose(*scheds: FaultSchedule) -> FaultSchedule:
+    """Overlay schedules of equal length: a link is up iff up in ALL
+    (partitions stack with loss), a node crashes/stalls/dups if ANY says
+    so."""
+    assert scheds, "compose() needs at least one schedule"
+    T = scheds[0].n_ticks
+    assert all(s.n_ticks == T for s in scheds), "tick counts differ"
+    out = scheds[0]
+    for s in scheds[1:]:
+        out = FaultSchedule(
+            link_up=out.link_up & s.link_up,
+            crash=out.crash | s.crash,
+            stall=out.stall | s.stall,
+            dup=out.dup | s.dup,
+        )
+    return out
+
+
+def concat(*scheds: FaultSchedule) -> FaultSchedule:
+    """Concatenate schedules along the tick axis (phased scenarios)."""
+    import jax
+    import jax.numpy as jnp
+
+    assert scheds, "concat() needs at least one schedule"
+    return jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *scheds)
+
+
+def chaos_mix(n_peers: int, n_ticks: int, *, seed: int = 0) -> FaultSchedule:
+    """The standard three-regime acceptance scenario (ISSUE 1), phased
+    over the run:
+
+    1. first third  — a split-brain window plus rolling partitions;
+    2. middle third — crash-restart storm plus clock stalls;
+    3. last third   — lossy links with duplication.
+
+    The division remainder (0-2 ticks, when 3 does not divide
+    ``n_ticks``) is padded healthy so the schedule length is exactly
+    ``n_ticks`` — that is NOT enough settle time for liveness.  Callers
+    asserting liveness (one leader, commits advance) after the run must
+    append real healthy time: ``run_nemesis_audited(...,
+    settle_ticks=...)`` or ``concat(sched, healthy(...))``; the election
+    lottery's slow tail needs more settle the more groups there are
+    (~240 ticks at 4k groups).
+    """
+    t3 = n_ticks // 3
+    tail = max(n_ticks - 3 * t3, 0)
+    p1 = compose(
+        split_brain(n_peers, t3, start=t3 // 4, stop=3 * t3 // 4, seed=seed),
+        rolling_partition(n_peers, t3, period=max(8, t3 // 4), heal_gap=4),
+    )
+    p2 = compose(
+        crash_storm(n_peers, t3, rate=0.03, seed=seed + 1),
+        clock_stalls(n_peers, t3, rate=0.02, max_len=5, seed=seed + 2),
+    )
+    p3 = lossy_links(n_peers, t3, drop_p=0.15, dup_p=0.1, seed=seed + 3)
+    parts = [p1, p2, p3]
+    if tail:
+        parts.append(healthy(n_peers, tail))
+    return concat(*parts)
+
+
+# --------------------------------------------------------------- audit ----
+
+def _slice_schedule(sched: FaultSchedule, lo: int, hi: int) -> FaultSchedule:
+    import jax
+    return jax.tree.map(lambda a: a[lo:hi], sched)
+
+
+def run_nemesis_audited(cfg: EngineConfig, sched: FaultSchedule, *,
+                        seed: int = 0, submit: int = 2,
+                        audit_every: int = 32, settle_ticks: int = 0,
+                        checker=None):
+    """Run a fault schedule on device, auditing safety between windows.
+
+    The schedule executes as fused ``run_cluster_ticks_nemesis`` scans of
+    ``audit_every`` ticks (no per-tick host loop — the host only touches
+    the run at window boundaries to pull a snapshot for the
+    ``ClusterChecker``).  ``settle_ticks`` appends an all-healthy tail so
+    callers can assert liveness (single leader, commits) after the chaos.
+
+    Returns ``(states, checker, snapshot)`` — the final stacked state, the
+    (accumulating) checker, and the final host snapshot dict.
+    """
+    import jax.numpy as jnp
+
+    from ..core.cluster import DeviceCluster
+    from ..core.sim import run_cluster_ticks_nemesis
+    from .invariants import ClusterChecker, cluster_snapshot
+
+    if settle_ticks:
+        sched = concat(sched, healthy(cfg.n_peers, settle_ticks))
+    c = DeviceCluster(cfg, seed=seed)
+    chk = checker if checker is not None else ClusterChecker(cfg)
+    states, inflight, info = c.states, c.inflight, c.last_info
+    sub = jnp.full((cfg.n_peers, cfg.n_groups), submit, jnp.int32)
+    T = sched.n_ticks
+    snap = cluster_snapshot(states)
+    chk.check(snap)
+    done = 0
+    import numpy as _np
+    crash_np = _np.asarray(sched.crash)
+    while done < T:
+        step = min(audit_every, T - done)
+        states, inflight, info = run_cluster_ticks_nemesis(
+            cfg, states, inflight, info,
+            _slice_schedule(sched, done, done + step), sub)
+        crashed = crash_np[done:done + step].any(axis=0)
+        done += step
+        snap = cluster_snapshot(states)
+        chk.check(snap, crashed=crashed)
+    chk.check_log_matching(snap)
+    return states, chk, snap
+
+
+def assert_nemesis_deterministic(cfg: EngineConfig, sched: FaultSchedule, *,
+                                 seed: int = 0, submit: int = 2) -> None:
+    """Same seed + same schedule ⇒ bit-identical final state.
+
+    Runs the WHOLE schedule as one fused scan, twice, from two
+    independently built clusters, and requires every leaf of the final
+    RaftState (including PRNG keys and per-node clocks) to match exactly.
+    This is the replayability guarantee chaos debugging rests on: a
+    violating run can be re-executed under instrumentation and will take
+    the identical path.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..core.cluster import DeviceCluster
+    from ..core.sim import run_cluster_ticks_nemesis
+
+    sub = jnp.full((cfg.n_peers, cfg.n_groups), submit, jnp.int32)
+
+    def one_run():
+        c = DeviceCluster(cfg, seed=seed)
+        states, _, _ = run_cluster_ticks_nemesis(
+            cfg, c.states, c.inflight, c.last_info, sched, sub)
+        return states
+
+    a, b = one_run(), one_run()
+    flat_a, _ = jax.tree_util.tree_flatten_with_path(a)
+    flat_b = jax.tree.leaves(b)
+    for (path, la), lb in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(
+            np.asarray(la), np.asarray(lb),
+            err_msg=f"nemesis run not deterministic at {jax.tree_util.keystr(path)}")

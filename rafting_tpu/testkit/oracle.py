@@ -556,12 +556,20 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         full = match_idx[g].copy()
         full[me] = log.last
         quorum_idx = int(np.sort(full)[P - maj])
+        full_idx = int(full.min())
         # Own-term rule via own_from (terms monotone along the log; set at
         # election win) — mirrors ops/quorum.py exactly.
         if (active[g] and role[g] == LEADER and quorum_idx > commit[g]
                 and quorum_idx >= own_from_a[g]
                 and quorum_idx <= log.last):
             commit[g] = quorum_idx
+        # Full-replication lane (reference Leader.java:260, mirrors
+        # ops/quorum.py): min of the match row commits without the
+        # own-term fence — identical on every node, hence on every
+        # possible future leader.
+        if (active[g] and role[g] == LEADER and full_idx > commit[g]
+                and full_idx <= log.last):
+            commit[g] = full_idx
         match_idx[g] = full
 
         ring[g] = log.ring

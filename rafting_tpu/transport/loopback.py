@@ -22,10 +22,31 @@ class LoopbackNetwork:
         self._lock = threading.Lock()
         # conn[s][d] False = link cut
         self.conn = [[True] * n_nodes for _ in range(n_nodes)]
+        # dup[s][d] True = every MSGS frame over s->d is delivered twice
+        # (nemesis duplicate-delivery regime: the host-path analog of
+        # FaultSchedule.dup, exercising stale/duplicate RPC idempotency
+        # through the real codec round-trip)
+        self.dup = [[False] * n_nodes for _ in range(n_nodes)]
 
     def set_link(self, src: int, dst: int, up: bool) -> None:
         with self._lock:
             self.conn[src][dst] = up
+
+    def set_conn(self, conn) -> None:
+        """Adopt a whole [N, N] connectivity matrix at once — the bulk
+        entry point nemesis schedule replay drives per tick
+        (testkit/harness.py ``LocalCluster.replay_schedule``)."""
+        with self._lock:
+            for s in range(self.n):
+                for d in range(self.n):
+                    self.conn[s][d] = bool(conn[s][d])
+
+    def set_dup(self, dup) -> None:
+        """Adopt a whole [N, N] duplicate-delivery matrix."""
+        with self._lock:
+            for s in range(self.n):
+                for d in range(self.n):
+                    self.dup[s][d] = bool(dup[s][d])
 
     def partition(self, sides) -> None:
         with self._lock:
@@ -43,6 +64,10 @@ class LoopbackNetwork:
     def _up(self, s: int, d: int) -> bool:
         with self._lock:
             return self.conn[s][d]
+
+    def _dup(self, s: int, d: int) -> bool:
+        with self._lock:
+            return self.dup[s][d]
 
 
 class LoopbackTransport:
@@ -74,12 +99,18 @@ class LoopbackTransport:
         t = self.net.transports.get(dst)
         if t is None:
             return  # peer down
-        ftype_body = codec.FrameReader().feed(packed)
-        for ftype, body in ftype_body:
-            if ftype == codec.MSGS:
-                src, fields, payloads = codec.unpack_slice(
-                    body, t.template, t.cfg.n_groups)
-                t.on_slice(src, fields, payloads)
+        # Duplicate-delivery links (nemesis schedule replay) hand the same
+        # frame to the receiver twice — the receiving stack must be
+        # idempotent against replayed RPCs, exactly like the device
+        # plane's FaultSchedule.dup lane.
+        rounds = 2 if self.net._dup(self.node_id, dst) else 1
+        for _ in range(rounds):
+            ftype_body = codec.FrameReader().feed(packed)
+            for ftype, body in ftype_body:
+                if ftype == codec.MSGS:
+                    src, fields, payloads = codec.unpack_slice(
+                        body, t.template, t.cfg.n_groups)
+                    t.on_slice(src, fields, payloads)
 
     def forward_submit(self, peer: int, group: int, payload: bytes,
                        timeout: float = 30.0):

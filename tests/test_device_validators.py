@@ -37,6 +37,19 @@ def _device_env() -> dict:
 
 
 def _run_validator(name: str, n_groups: int, timeout: int):
+    # Probe the default backend FIRST (throwaway subprocess, hard
+    # timeout): without this, a CPU-only environment runs the whole 8k
+    # validator as a ~9-minute CPU fallback just to discover at the end
+    # that it must skip — which is exactly what happened when a `-m 'not
+    # slow'` invocation overrode the addopts opt-in filter and pulled
+    # these tests into the tier-1 budget.
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from __graft_entry__ import _probe_default_backend
+    count, plat = _probe_default_backend(timeout=45)
+    if not count or plat == "cpu":
+        pytest.skip(f"no accelerator present (probe: {count} x "
+                    f"{plat or 'none'})")
     tool = os.path.join(REPO, "tools", name)
     try:
         r = subprocess.run([sys.executable, tool, str(n_groups)],

@@ -176,13 +176,41 @@ def test_vote_rejected_for_stale_log():
 
 
 def test_commit_only_own_term():
-    """A leader must not commit entries from a previous term by counting
-    replicas (Raft §5.4.2; reference Leader.java:256-261)."""
+    """A leader must not commit prior-term entries by counting a MAJORITY
+    of replicas (Raft §5.4.2; reference Leader.java:256-261).  Full
+    replication (min of the match row, Leader.java:260) is the one legal
+    exception — tested separately below."""
     cfg = cfg3()
     st = follower_with_log(cfg, term=2, entry_terms=[1, 1])
-    # Force leadership at term 2 with a fully-matched old-term log.
-    # own_from = 3 is what the election-win phase would have set (first
-    # index of OUR term = tail+1; the rule under test is quorum >= it).
+    # Force leadership at term 2 with a MAJORITY-matched old-term log
+    # (peer 2 lags, so the full-replication lane stays closed and the
+    # own-term fence is what's under test).  own_from = 3 is what the
+    # election-win phase would have set (first index of OUR term =
+    # tail+1; the rule under test is quorum >= it).
+    st = st.replace(
+        role=jnp.asarray([LEADER], I32),
+        leader_id=jnp.asarray([0], I32),
+        match_idx=jnp.asarray([[2, 2, 0]], I32),
+        next_idx=jnp.asarray([[3, 3, 1]], I32),
+        own_from=jnp.asarray([3], I32),
+    )
+    st2, _, _ = node_step(cfg, st, Messages.empty(cfg), HostInbox.empty(cfg))
+    assert int(st2.commit[0]) == 0, "old-term entries need a new-term cover"
+    # Now append an own-term entry and match it on a majority: commits.
+    host = HostInbox.empty(cfg).replace(submit_n=jnp.asarray([1], I32))
+    st3, _, info = node_step(cfg, st2, Messages.empty(cfg), host)
+    st3 = st3.replace(match_idx=jnp.asarray([[3, 3, 0]], I32))
+    st4, _, _ = node_step(cfg, st3, Messages.empty(cfg), HostInbox.empty(cfg))
+    assert int(st4.commit[0]) == 3, "own-term cover commits the whole prefix"
+
+
+def test_commit_full_replication_lane():
+    """A prior-term suffix replicated on EVERY node commits without an
+    own-term cover (reference Leader.java:260 fullIndex): identical on
+    all nodes means on every electable future leader — the lane that
+    un-wedges a ring-full group whose §8 no-op could not be appended."""
+    cfg = cfg3()
+    st = follower_with_log(cfg, term=2, entry_terms=[1, 1])
     st = st.replace(
         role=jnp.asarray([LEADER], I32),
         leader_id=jnp.asarray([0], I32),
@@ -191,13 +219,8 @@ def test_commit_only_own_term():
         own_from=jnp.asarray([3], I32),
     )
     st2, _, _ = node_step(cfg, st, Messages.empty(cfg), HostInbox.empty(cfg))
-    assert int(st2.commit[0]) == 0, "old-term entries need a new-term cover"
-    # Now append an own-term entry and match it everywhere: commits through.
-    host = HostInbox.empty(cfg).replace(submit_n=jnp.asarray([1], I32))
-    st3, _, info = node_step(cfg, st2, Messages.empty(cfg), host)
-    st3 = st3.replace(match_idx=jnp.asarray([[3, 3, 3]], I32))
-    st4, _, _ = node_step(cfg, st3, Messages.empty(cfg), HostInbox.empty(cfg))
-    assert int(st4.commit[0]) == 3, "own-term cover commits the whole prefix"
+    assert int(st2.commit[0]) == 2, \
+        "fully-replicated prior-term suffix must commit"
 
 
 def test_heartbeat_reply_echoes_empty_flag():

@@ -49,6 +49,28 @@ def test_pallas_quorum_matches_reference(P, majority, L):
     np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
 
 
+def test_full_replication_commit_lane():
+    """Reference Leader.java:260: an index replicated on ALL nodes (min of
+    the match row) commits even below own_from — the lane that lets a
+    fully-replicated prior-term suffix commit on a ring-full lane where
+    the §8 no-op could not be appended.  A majority-only match must still
+    respect the own-term fence."""
+    own_from = jnp.asarray([5, 5], jnp.int32)   # no own-term entry yet
+    last = jnp.asarray([4, 4], jnp.int32)
+    commit = jnp.asarray([0, 0], jnp.int32)
+    lead = jnp.asarray([True, True])
+    # Group 0: full replication at 4 -> commits to 4 despite own_from=5.
+    # Group 1: majority at 4 but one peer at 0 -> fence holds, commit 0.
+    match = jnp.asarray([[4, 4, 4], [4, 4, 0]], jnp.int32)
+    got = quorum_commit_ref(match, own_from, last, commit, lead, 2)
+    np.testing.assert_array_equal(np.asarray(got), [4, 0])
+    # The Pallas kernel implements the same two lanes.
+    state_vec = jnp.stack([commit, last, lead.astype(jnp.int32)])
+    interpret = jax.default_backend() != "tpu"
+    got_k = quorum_commit_pallas(match, own_from, state_vec, 2, interpret)
+    np.testing.assert_array_equal(np.asarray(got_k), [4, 0])
+
+
 def test_engine_parity_with_pallas_quorum():
     """A full cluster run with use_pallas=True must behave identically to
     the jnp path: elect one leader per group and commit under load."""
