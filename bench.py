@@ -80,6 +80,19 @@ def child_run(n_groups: int, measure_ticks: int, warmup_ticks: int,
     # state); only the measured window runs the schedule, entirely inside
     # fused scans.
     nemesis_on = env_flag("BENCH_NEMESIS")
+    # BENCH_READS=1: measure the LINEARIZABLE READ PLANE instead of pure
+    # append throughput — a warm-compiled mixed 90/10 read/write load
+    # (per tick per group: one ReadIndex batch of 9*max_submit queries +
+    # max_submit log writes), entirely inside the fused scan
+    # (core/sim.py run_cluster_ticks_reads).  Reads never touch the log,
+    # so the headline is reads/sec on top of a still-live write stream.
+    reads_on = env_flag("BENCH_READS")
+    if reads_on and nemesis_on:
+        # The reads scan measures the HEALTHY path; silently honoring both
+        # flags would label a fault-free measurement as a chaos number.
+        raise SystemExit("BENCH_READS and BENCH_NEMESIS are mutually "
+                         "exclusive: the read stage measures the healthy "
+                         "path (a faults-on reads scan does not exist yet)")
     # Pipeline budget knobs.  Defaults are the proven-on-TPU envelope
     # (r1 data was taken at L=64/B=8); the CPU fallback overrides them to
     # the tuned point from the 32k-group sweep (S=32/B=32/L=256 ~ 2.1x —
@@ -139,6 +152,28 @@ def child_run(n_groups: int, measure_ticks: int, warmup_ticks: int,
             done += step
         return states, inflight, info
 
+    if reads_on:
+        from rafting_tpu.core.sim import run_cluster_ticks_reads
+        # 90/10 offered mix: 9*S reads per group-tick ride one ReadIndex
+        # batch; S writes flow beside them.
+        read_load = jnp.full((n_peers, n_groups), 9 * cfg.max_submit,
+                             jnp.int32)
+        read_totals = {"served": 0, "lease": 0, "appended": 0}
+
+        def run_chunks_reads(n_ticks, states, inflight, info):
+            done = 0
+            served = lease = appended = 0
+            while done < n_ticks:
+                step = min(chunk, n_ticks - done)
+                states, inflight, info, sv, lh, ap = run_cluster_ticks_reads(
+                    cfg, step, states, inflight, info, c.conn, submit,
+                    read_load)
+                # Lazy device scalars: summed on device, pulled once after
+                # the measured window (the commit read is the fence).
+                served, lease, appended = served + sv, lease + lh, appended + ap
+                done += step
+            return states, inflight, info, served, lease, appended
+
     if nemesis_on:
         from rafting_tpu.core.sim import run_cluster_ticks_nemesis
         from rafting_tpu.testkit import nemesis as _nem
@@ -175,13 +210,24 @@ def child_run(n_groups: int, measure_ticks: int, warmup_ticks: int,
             states, inflight, info = run_cluster_ticks_nemesis(
                 cfg, states, inflight, info,
                 _nem.healthy(n_peers, step), submit)
+    if reads_on:
+        # Same warm-compile discipline for the reads scan (the read load
+        # is data; only the per-step-size programs need building).
+        for step in sorted({min(chunk, measure_ticks - d)
+                            for d in range(0, measure_ticks, chunk)}):
+            states, inflight, info, *_ = run_cluster_ticks_reads(
+                cfg, step, states, inflight, info, c.conn, submit,
+                read_load)
     start_commit = commit_sum(states)
     warm_s = time.perf_counter() - t0
 
     def measure():
         nonlocal states, inflight, info
         t0 = time.perf_counter()
-        if nemesis_on:
+        if reads_on:
+            states, inflight, info, sv, lh, ap = run_chunks_reads(
+                measure_ticks, states, inflight, info)
+        elif nemesis_on:
             states, inflight, info = run_chunks_faulted(states, inflight,
                                                         info)
         else:
@@ -190,6 +236,10 @@ def child_run(n_groups: int, measure_ticks: int, warmup_ticks: int,
         # The commit read fences the elapsed time; its cost ([N, G] i32
         # pull) is part of the measurement and negligible at every scale.
         commit_sum(states)
+        if reads_on:
+            read_totals["served"] = int(np.asarray(sv))
+            read_totals["lease"] = int(np.asarray(lh))
+            read_totals["appended"] = int(np.asarray(ap))
         return time.perf_counter() - t0
 
     from rafting_tpu.utils.profiling import device_trace
@@ -211,7 +261,7 @@ def child_run(n_groups: int, measure_ticks: int, warmup_ticks: int,
     assert commits > 0
 
     faulthandler.cancel_dump_traceback_later()
-    return {
+    res = {
         "scale": n_groups,
         "platform": dev.platform,
         "cps": commits / elapsed,
@@ -222,6 +272,16 @@ def child_run(n_groups: int, measure_ticks: int, warmup_ticks: int,
         "init_s": round(init_s, 2),
         "nemesis": nemesis_on,
     }
+    if reads_on:
+        assert read_totals["served"] > 0, "read stage served nothing"
+        res.update(
+            reads=read_totals["served"],
+            rps=read_totals["served"] / elapsed,
+            lease_hits=read_totals["lease"],
+            appended=read_totals["appended"],
+            read_mix="90/10",
+        )
+    return res
 
 
 def headline(res: dict, fallback: str = "", tuned: bool = False,
@@ -244,6 +304,27 @@ def headline(res: dict, fallback: str = "", tuned: bool = False,
         "value": round(res["cps"]),
         "unit": "commits/sec",
         "vs_baseline": round(res["cps"] / BASELINE_CPS, 3),
+    }
+
+
+def headline_reads(res: dict) -> dict:
+    """The read-plane headline: linearizable reads/sec under a mixed
+    90/10 read/write load.  A SEPARATE metric from the commits/sec
+    ladder — reads bypass the log, so the two are not directly
+    comparable; its baseline is the mix-implied read throughput AT the
+    commits baseline (90/10 mix at BASELINE_CPS writes = 9x reads), so
+    vs_baseline == 1.0 means the read plane keeps pace with a
+    baseline-rate write stream, not a unit-mismatched commits ratio."""
+    plat = res["platform"]
+    tag = "" if plat == "cpu" else " on device"
+    return {
+        "metric": f"linearizable reads/sec @{res['scale'] // 1000}k Raft "
+                  f"groups (ReadIndex+lease, mixed {res['read_mix']} "
+                  f"read/write, 3-node cluster, device engine{tag}) "
+                  f"[writes rode along at {round(res['cps'])} commits/sec]",
+        "value": round(res["rps"]),
+        "unit": "reads/sec",
+        "vs_baseline": round(res["rps"] / (9 * BASELINE_CPS), 3),
     }
 
 
@@ -488,12 +569,41 @@ def main() -> None:
                 and not any(k in os.environ for k in TUNED_ENV)):
             bonus(TUNED_ENV, "tuned budget", 96, 48, bonus_timeout)
 
+    # Read-plane stage: linearizable reads/sec (mixed 90/10 read/write,
+    # ReadIndex + lease) at the best surviving scale — a SEPARATE headline
+    # that never replaces the commits/sec number.  Skipped when the
+    # operator pinned BENCH_READS (then the whole ladder measured reads)
+    # or BENCH_NEMESIS (the flags are mutually exclusive in the child).
+    if (best is not None and "BENCH_READS" not in os.environ
+            and "BENCH_NEMESIS" not in os.environ):
+        remaining = budget - (time.monotonic() - t_start)
+        rd_timeout = float(os.environ.get("BENCH_READS_TIMEOUT", "300"))
+        if remaining >= rd_timeout * 0.4:
+            ticks, warmup = ((512, 128) if best["platform"] != "cpu"
+                             else (96, 48))
+            res = run_scale(best["scale"], ticks, warmup,
+                            min(rd_timeout, remaining),
+                            platform="cpu" if best["platform"] == "cpu"
+                            else "",
+                            extra_env={"BENCH_READS": "1"})
+            if res is not None and "rps" in res:
+                sys.stderr.write(f"[bench] read plane: "
+                                 f"{res['rps']:,.0f} reads/s "
+                                 f"({res['lease_hits']} lease hits)\n")
+                emit(headline_reads(res))
+    elif best is not None and "rps" in best:
+        # Operator-pinned BENCH_READS ladder: the banked headline above
+        # was commits/sec — emit the reads/sec number it was run for.
+        emit(headline_reads(best))
+
     # Faults-on stage: commits/sec under the standard nemesis schedule at
     # the best surviving scale — a SEPARATE headline (chaos throughput is
     # not comparable to the healthy number, so it never replaces `best`).
     # Skipped when the operator already pinned BENCH_NEMESIS (then the
-    # whole ladder above was the faults-on run).
-    if best is not None and "BENCH_NEMESIS" not in os.environ:
+    # whole ladder above was the faults-on run) or BENCH_READS (the child
+    # refuses the flag combination).
+    if (best is not None and "BENCH_NEMESIS" not in os.environ
+            and "BENCH_READS" not in os.environ):
         remaining = budget - (time.monotonic() - t_start)
         nem_timeout = float(os.environ.get("BENCH_NEMESIS_TIMEOUT", "300"))
         if remaining >= nem_timeout * 0.4:

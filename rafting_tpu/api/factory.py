@@ -53,7 +53,8 @@ class RaftFactory:
             return TcpTransport(node.node_id, peers, node.cfg,
                                 node.template, on_slice, snapshot_provider,
                                 submit_handler=node.submit,
-                                result_encoder=node.serializer.encode_result)
+                                result_encoder=node.serializer.encode_result,
+                                read_handler=node.read)
         return build
 
     def maintain(self, config: RaftConfig):

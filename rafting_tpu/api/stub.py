@@ -7,6 +7,7 @@ command/RaftStub.java:47-110, RaftContainer.getStub:92-111)."""
 
 from __future__ import annotations
 
+import random
 import threading
 from concurrent.futures import Future, TimeoutError as _FutTimeout
 from typing import Any, Optional, Union
@@ -19,7 +20,7 @@ from .anomaly import (
 
 class RaftStub:
     def __init__(self, container, name: str, lane: int, forward: bool = True,
-                 forward_budget: float = 20.0):
+                 forward_budget: float = 20.0, max_redirects: int = 16):
         """``forward=True`` relays submissions to the current leader over
         the transport when this node is a follower, instead of bouncing
         NotLeader back to the caller (the reference only returns the hint,
@@ -33,12 +34,22 @@ class RaftStub:
         leader hints when no explicit per-call timeout is given;
         ``execute(timeout=...)`` overrides it per call, and every
         per-attempt wait is capped by the remaining budget — worst-case
-        caller latency is the budget, not budget + a trailing attempt."""
+        caller latency is the budget, not budget + a trailing attempt.
+
+        ``max_redirects``: hard cap on refusal-driven retries inside one
+        forwarded call.  During an election a command (or read) can
+        ping-pong between ex-leaders whose hints point at each other —
+        each hop a fresh NotLeader — and a purely time-bounded loop burns
+        the whole budget doing it.  After this many redirects the last
+        refusal surfaces to the caller even with budget left.  Retries
+        back off exponentially with +/-50% jitter (decorrelating the
+        thundering herd of callers all chasing the same election)."""
         self._container = container
         self.name = name
         self._lane = lane
         self.forward = forward
         self.forward_budget = forward_budget
+        self.max_redirects = max_redirects
         self._closed = False
 
     @property
@@ -87,6 +98,57 @@ class RaftStub:
             return fut
         return self._forwarded(payload, timeout)
 
+    def read(self, query: Union[bytes, str],
+             timeout: Optional[float] = None) -> Future:
+        """Async linearizable read (the read plane, core/step.py phase 8b):
+        resolves with the state machine's ``read(query)`` result WITHOUT
+        appending to the log — the leader stamps a ReadIndex and serves
+        once a quorum confirms its leadership and the apply frontier
+        covers the stamp.  Queries travel through the same CmdSerializer
+        as commands.  Reads never enter any log, so every failure is a
+        marked retry-safe refusal; with ``forward=True`` a non-leader stub
+        relays the read to the leader (bounded by ``forward_budget`` /
+        ``max_redirects``, like submit)."""
+        if self._closed:
+            raise ObsoleteContextError(f"stub for {self.name!r} closed")
+        node = self._container._node
+        payload = node.serializer.encode_command(query)
+        if node.is_leader(self.lane) or not self.forward:
+            fut = node.read(self.lane, payload)
+            exc = fut.exception() if fut.done() else None
+            if (self.forward and exc is not None and is_refusal(exc)
+                    and type(exc).__name__ in self._TRANSIENT_REFUSALS):
+                return self._forwarded(payload, timeout, read=True)
+            return fut
+        return self._forwarded(payload, timeout, read=True)
+
+    def read_batch(self, queries) -> Future:
+        """Many linearizable queries under ONE ReadIndex barrier (one
+        future resolving to the list of results in order) — the batch
+        amortization the read plane exists for.  Leader-local only: a
+        non-leader stub's batch fails NotLeader (forward the individual
+        reads or redirect the batch by hint).  No timeout parameter on
+        purpose: the batch is never forwarded, so there is no retry chase
+        to bound — bound the wait on the FUTURE (``.result(timeout=…)``),
+        as with submit."""
+        if self._closed:
+            raise ObsoleteContextError(f"stub for {self.name!r} closed")
+        node = self._container._node
+        enc = node.serializer.encode_command
+        return node.read_batch(self.lane, [enc(q) for q in queries])
+
+    def execute_read(self, query: Union[bytes, str],
+                     timeout: Optional[float] = None) -> Any:
+        """Blocking linearizable read (the read-plane sibling of
+        :meth:`execute`); ``timeout`` bounds the whole call including any
+        forward-retry chase."""
+        fut = self.read(query, timeout=timeout)
+        try:
+            return fut.result(timeout=timeout)
+        except _FutTimeout:
+            raise WaitTimeoutError(
+                f"read on {self.name!r} not served in {timeout}s")
+
     # Pre-log refusals are identified by the as_refusal marker set at
     # their creation sites (api/anomaly.py) — never by exception type or
     # future-completion timing: a step-down abort of an ACCEPTED command
@@ -100,25 +162,32 @@ class RaftStub:
                            "BusyLoopError")
 
     def _forwarded(self, payload: bytes,
-                   budget: Optional[float] = None) -> Future:
+                   budget: Optional[float] = None,
+                   read: bool = False) -> Future:
         """Relay to the leader from a worker thread (the forward channel is
         a blocking ephemeral connection).  Elections and readiness are
-        transient: while the submission keeps being REFUSED (locally or by
+        transient: while the operation keeps being REFUSED (locally or by
         the remote serve side) without ever entering a log, re-resolve the
-        hint and retry until the forward budget runs out instead of
-        bouncing the first refusal to the caller (reference clients chase
-        NotLeaderException hints, support/anomaly/
-        NotLeaderException.java:11-27).  ``budget`` (default the stub's
-        forward_budget) is the OVERALL deadline: every per-attempt wait
-        below is capped by what remains of it."""
+        hint and retry — but BOUNDED twice over: ``budget`` (default the
+        stub's forward_budget) is the overall wall deadline, and
+        ``max_redirects`` caps the refusal-driven retry COUNT, so an
+        election whose ex-leaders hint at each other cannot ping-pong the
+        call for the whole budget (reference clients chase
+        NotLeaderException hints, support/anomaly/NotLeaderException.java:
+        11-27 — with no cap at all).  Each retry backs off exponentially
+        with +/-50% jitter to decorrelate competing callers.  ``read``
+        routes through node.read / transport.forward_read (the read
+        plane) instead of submit."""
         node = self._container._node
         lane = self.lane
         out: Future = Future()
         total = self.forward_budget if budget is None else budget
+        what = "read" if read else "command"
 
         def run():
             import time as _time
             overall = _time.monotonic() + total
+            retries = 0
 
             def left() -> float:
                 # Per-attempt cap: never let one blocking wait overrun the
@@ -127,13 +196,29 @@ class RaftStub:
                 # budget from turning into a zero-timeout busy loop.
                 return max(0.05, overall - _time.monotonic())
 
+            def backoff(last_refusal: Exception) -> None:
+                # Count + sleep for ONE refusal-driven retry.  Raises the
+                # refusal once either bound trips; jittered exponential
+                # sleep otherwise (0.05s doubling, capped at 0.5s).
+                nonlocal retries
+                retries += 1
+                if retries > self.max_redirects:
+                    raise last_refusal
+                if _time.monotonic() >= overall:
+                    raise last_refusal
+                _time.sleep(min(0.5, 0.05 * (2 ** min(retries, 4)))
+                            * random.uniform(0.5, 1.5))
+
             try:
+                local_op = node.read if read else node.submit
+                remote_op = (node.transport.forward_read if read
+                             else node.transport.forward_submit)
                 while True:
                     # Resolve a target: ourselves if leadership landed
                     # here, else the current hint.
                     while True:
                         if node.is_leader(lane):
-                            fut = node.submit(lane, payload)
+                            fut = local_op(lane, payload)
                             exc = fut.exception() if fut.done() else None
                             if (exc is not None and is_refusal(exc)
                                     and type(exc).__name__
@@ -141,9 +226,7 @@ class RaftStub:
                                 # Marked pre-log refusal: never entered
                                 # the log — keep resolving (same
                                 # treatment as a remote REFUSED reply).
-                                if _time.monotonic() >= overall:
-                                    raise exc
-                                _time.sleep(0.05)
+                                backoff(exc)
                                 continue
                             # Accepted (or pending): wait for the result.
                             # A MARKED transient refusal raised later
@@ -160,32 +243,30 @@ class RaftStub:
                                 # budget: the command may still commit —
                                 # report the timeout, never resubmit.
                                 raise WaitTimeoutError(
-                                    f"forwarded command on {self.name!r} "
+                                    f"forwarded {what} on {self.name!r} "
                                     f"not resolved in {total}s")
                             except Exception as e:
                                 if (is_refusal(e) and type(e).__name__
-                                        in self._TRANSIENT_REFUSALS
-                                        and _time.monotonic() < overall):
-                                    _time.sleep(0.05)
+                                        in self._TRANSIENT_REFUSALS):
+                                    backoff(e)
                                     continue
                                 raise
                         hint = node.leader_hint(lane)
                         if hint is not None and hint != node.node_id:
                             break
-                        if _time.monotonic() >= overall:
-                            raise NotLeaderError(lane, None)
-                        _time.sleep(0.05)
-                    ok, raw = node.transport.forward_submit(
-                        hint, self.lane, payload, timeout=left())
+                        backoff(NotLeaderError(lane, None))
+                    ok, raw = remote_op(hint, self.lane, payload,
+                                        timeout=left())
                     if ok:
                         out.set_result(node.serializer.decode_result(raw))
                         return
                     msg = raw.decode(errors="replace")
                     kind = msg.split(":", 2)[1] if ":" in msg else ""
                     if (msg.startswith("REFUSED:")
-                            and kind in self._TRANSIENT_REFUSALS
-                            and _time.monotonic() < overall):
-                        _time.sleep(0.1)
+                            and kind in self._TRANSIENT_REFUSALS):
+                        backoff(NotLeaderError(lane, hint)
+                                if kind == "NotLeaderError"
+                                else RaftError(msg))
                         continue
                     if msg.startswith("REFUSED:ObsoleteContextError"):
                         # Permanent refusal: surface the right type

@@ -163,7 +163,8 @@ def cluster_step_nemesis(cfg: EngineConfig, states: RaftState,
 
 @partial(jax.jit, static_argnums=(0, 3))
 def auto_host_inbox(cfg: EngineConfig, states: RaftState, submit_n: jax.Array,
-                    compact, prev_info: StepInfo) -> HostInbox:
+                    compact, prev_info: StepInfo,
+                    read_n: Optional[jax.Array] = None) -> HostInbox:
     """Build a HostInbox batch [N, ...] for the self-driving harness.
 
     Policy (the steady-state behavior of a host runtime whose state machines
@@ -177,6 +178,11 @@ def auto_host_inbox(cfg: EngineConfig, states: RaftState, submit_n: jax.Array,
       back as this tick's ``snap_done`` (the payload-less analog of the
       reference's out-of-band snapshot channel, EventNode.java:122-267).
 
+    ``read_n`` ([N, G] int32, optional): linearizable reads offered per
+    group per tick (the read-plane analog of ``submit_n``; only leaders
+    with a free ReadIndex slot stamp them — unstamped offers are simply
+    re-offered next tick by this self-driving policy).
+
     ``compact``: False = never; True = every tick (the bench steady state);
     int K > 1 = every K ticks.  The cadence matters for laggard catch-up
     under SUSTAINED load: an every-tick floor advances continuously and
@@ -189,8 +195,10 @@ def auto_host_inbox(cfg: EngineConfig, states: RaftState, submit_n: jax.Array,
     """
     G = cfg.n_groups
     slack = cfg.log_slots // 4
+    if read_n is None:
+        read_n = jnp.zeros(submit_n.shape, jnp.int32)
 
-    def one(st, sub, info):
+    def one(st, sub, rd, info):
         hi = HostInbox.empty(cfg)
         if compact is True:
             ct = jnp.maximum(st.commit - slack, 0)
@@ -202,12 +210,13 @@ def auto_host_inbox(cfg: EngineConfig, states: RaftState, submit_n: jax.Array,
             ct = jnp.zeros((G,), jnp.int32)
         return hi.replace(
             submit_n=sub,
+            read_n=rd,
             compact_to=ct,
             snap_done=info.snap_req,
             snap_idx=info.snap_req_idx,
             snap_term=info.snap_req_term,
         )
-    return jax.vmap(one)(states, submit_n, prev_info)
+    return jax.vmap(one)(states, submit_n, read_n, prev_info)
 
 
 def cluster_snapshot(states: RaftState) -> dict:
@@ -276,17 +285,18 @@ class DeviceCluster:
         self.set_partition([[n for n in range(N) if n != node], [node]])
 
     # -- stepping -----------------------------------------------------------
-    def tick(self, submit_n=None, host: Optional[HostInbox] = None) -> StepInfo:
+    def tick(self, submit_n=None, host: Optional[HostInbox] = None,
+             read_n=None) -> StepInfo:
         N, G = self.cfg.n_peers, self.cfg.n_groups
         if host is None:
-            if submit_n is None:
-                sub = jnp.zeros((N, G), jnp.int32)
-            else:
-                sub = jnp.asarray(submit_n, jnp.int32)
-                if sub.ndim == 0:
-                    sub = jnp.broadcast_to(sub, (N, G))
-            host = auto_host_inbox(self.cfg, self.states, sub, self.compact,
-                                   self.last_info)
+            def dense(v):
+                if v is None:
+                    return jnp.zeros((N, G), jnp.int32)
+                v = jnp.asarray(v, jnp.int32)
+                return jnp.broadcast_to(v, (N, G)) if v.ndim == 0 else v
+            host = auto_host_inbox(self.cfg, self.states, dense(submit_n),
+                                   self.compact, self.last_info,
+                                   dense(read_n))
         self.states, self.inflight, info = cluster_step(
             self.cfg, self.states, self.inflight, host, self.conn)
         self.last_info = info

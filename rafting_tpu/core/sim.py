@@ -30,11 +30,11 @@ from .types import EngineConfig, FaultSchedule, Messages, RaftState, StepInfo
 
 def _scan_ticks(cfg: EngineConfig, n_ticks: int, states: RaftState,
                 inflight: Messages, prev_info: StepInfo, conn: jax.Array,
-                submit_n: jax.Array
+                submit_n: jax.Array, read_n=None
                 ) -> Tuple[RaftState, Messages, StepInfo]:
     def body(carry, _):
         states, inflight, info = carry
-        host = auto_host_inbox(cfg, states, submit_n, True, info)
+        host = auto_host_inbox(cfg, states, submit_n, True, info, read_n)
         states, inflight, info = cluster_step(cfg, states, inflight, host,
                                               conn)
         return (states, inflight, info), ()
@@ -47,22 +47,68 @@ def _scan_ticks(cfg: EngineConfig, n_ticks: int, states: RaftState,
 @partial(jax.jit, static_argnums=(0, 1), donate_argnums=(2, 3, 4))
 def run_cluster_ticks(cfg: EngineConfig, n_ticks: int, states: RaftState,
                       inflight: Messages, prev_info: StepInfo,
-                      conn: jax.Array, submit_n: jax.Array
-                      ) -> Tuple[RaftState, Messages, StepInfo]:
+                      conn: jax.Array, submit_n: jax.Array,
+                      read_n=None) -> Tuple[RaftState, Messages, StepInfo]:
     """Advance the cluster `n_ticks` ticks under a constant offered load.
 
     ``submit_n`` is [N, G]: commands offered to every node each tick (only
-    leaders accept).  Returns the final carry; per-tick outputs are not
-    materialized (the benchmark reads commit deltas from the state).
+    leaders accept).  ``read_n`` (optional, [N, G]) additionally offers
+    linearizable read batches each tick (read plane, core/step.py phase
+    8b; reads never touch the log).  Returns the final carry; per-tick
+    outputs are not materialized (the benchmark reads commit deltas from
+    the state — for read-plane accounting use
+    :func:`run_cluster_ticks_reads`).
     """
     return _scan_ticks(cfg, n_ticks, states, inflight, prev_info, conn,
-                       submit_n)
+                       submit_n, read_n)
+
+
+@partial(jax.jit, static_argnums=(0, 1), donate_argnums=(2, 3, 4))
+def run_cluster_ticks_reads(cfg: EngineConfig, n_ticks: int,
+                            states: RaftState, inflight: Messages,
+                            prev_info: StepInfo, conn: jax.Array,
+                            submit_n: jax.Array, read_n: jax.Array
+                            ) -> Tuple[RaftState, Messages, StepInfo,
+                                       jax.Array, jax.Array, jax.Array]:
+    """`run_cluster_ticks` with read-plane accounting in the carry.
+
+    Offers ``read_n`` [N, G] linearizable read batches per node per tick on
+    top of ``submit_n`` writes and accumulates, across the whole fused
+    scan: total individual reads served, total batches released by the
+    same-tick lease fast path, and total log entries appended (the bench's
+    zero-log-growth / mixed-load evidence).  Returns ``(states, inflight,
+    info, reads_served, lease_hits, appended)``.  The counters are i32
+    scalars like every engine lane (core/types.py I32 design): one scan
+    must keep ``n_ticks * N * G * reads_per_batch`` under ~2^31 — the
+    bench drives bounded chunks, so chunk totals never approach it (the
+    host sums chunks in Python ints).
+    """
+    from .types import I32
+
+    def body(carry, _):
+        states, inflight, info, served, lease, appended = carry
+        host = auto_host_inbox(cfg, states, submit_n, True, info, read_n)
+        states, inflight, info = cluster_step(cfg, states, inflight, host,
+                                              conn)
+        served = served + info.read_served.sum()
+        lease = lease + info.read_lease.astype(I32).sum()
+        appended = appended + jnp.where(
+            info.appended_to > 0,
+            info.appended_to - info.appended_from + 1, 0).sum()
+        return (states, inflight, info, served, lease, appended), ()
+
+    zero = jnp.zeros((), I32)
+    (states, inflight, info, served, lease, appended), _ = jax.lax.scan(
+        body, (states, inflight, prev_info, zero, zero, zero), None,
+        length=n_ticks)
+    return states, inflight, info, served, lease, appended
 
 
 @partial(jax.jit, static_argnums=0, donate_argnums=(1, 2, 3))
 def run_cluster_ticks_nemesis(cfg: EngineConfig, states: RaftState,
                               inflight: Messages, prev_info: StepInfo,
-                              sched: FaultSchedule, submit_n: jax.Array
+                              sched: FaultSchedule, submit_n: jax.Array,
+                              read_n=None
                               ) -> Tuple[RaftState, Messages, StepInfo]:
     """Advance the cluster ``sched.n_ticks`` ticks under a fault schedule.
 
@@ -77,14 +123,16 @@ def run_cluster_ticks_nemesis(cfg: EngineConfig, states: RaftState,
     counter-mode PRNG — there is no order-dependent float math to drift).
 
     ``submit_n`` is [N, G] constant offered load, as in
-    :func:`run_cluster_ticks`; the self-driving host policy
-    (``auto_host_inbox``: slack compaction + instant snapshot service) is
-    folded into the scan body, with a stalled node's StepInfo frozen so
-    its host half stalls with it.
+    :func:`run_cluster_ticks`; ``read_n`` (optional, [N, G]) offers
+    linearizable read batches under the same faults — the adversary run
+    the read plane's lease safety argument is tested against.  The
+    self-driving host policy (``auto_host_inbox``: slack compaction +
+    instant snapshot service) is folded into the scan body, with a
+    stalled node's StepInfo frozen so its host half stalls with it.
     """
     def body(carry, fault):
         states, inflight, info = carry
-        host = auto_host_inbox(cfg, states, submit_n, True, info)
+        host = auto_host_inbox(cfg, states, submit_n, True, info, read_n)
         states, inflight, info = cluster_step_nemesis(
             cfg, states, inflight, host, info, fault)
         return (states, inflight, info), ()

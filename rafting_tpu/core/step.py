@@ -31,9 +31,13 @@ Phase order within a tick (messages produced in tick t are delivered in t+1):
   4. AppendEntries reqs  — consistency check, conflict truncate, append, commit
   5. InstallSnapshot     — offer handling + completion events from host
   6. AppendEntries resps — leader match/next bookkeeping
+  6b. read evidence      — same-term ack receipts/echoes feed the barrier
   7. timers              — election timeout → PreVote round / new election
   8. submissions         — leader accepts client commands into the log
+  8b. read plane         — stamp ReadIndex batches, release on quorum
+                           barrier (lease fast path: same-tick evidence)
   9. replication         — leader builds AppendEntries / snapshot offers
+                           (+ barrier-kicked heartbeats, tick-stamped)
  10. commit advance      — quorum median over matchIndex, own-term rule
 """
 
@@ -63,6 +67,7 @@ DEBUG_CODES = {
     5: "candidate ballot is not itself",
     6: "commit regressed",
     7: "pipeline head behind ack base",
+    8: "read FIFO length out of range",
 }
 
 
@@ -385,6 +390,10 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     # window-full exempt heartbeat must not free a slot whose real ack
     # was lost (it would disarm the RPC-timeout detector one cadence).
     out_aer_occ = ae_v & inbox.ae_occ
+    # Echo the AE's send tick unconditionally (success or failure): any
+    # same-term reply proves we processed the leader's AE — the read
+    # plane's barrier evidence (the occupancy-echo idiom again).
+    out_aer_tick = jnp.where(ae_v, inbox.ae_tick, 0)
 
     # ---- 5. InstallSnapshot ------------------------------------------------
     # Device plane: an offer merely tells the follower's host to start the
@@ -488,6 +497,46 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     ok_at = jnp.where(aer_r, now, ok_at)
     fail_streak = jnp.where(aer_r, 0, fail_streak)
 
+    # ---- 6b. read-barrier evidence ----------------------------------------
+    # A same-term AE reply proves its sender followed us when it processed
+    # the AE (it reset its election timer, phase 4) — the leadership
+    # confirmation the ReadIndex barrier needs.  Two anchorings, both
+    # comparing only values of OUR OWN clock (stall-induced per-node
+    # drift cannot skew them):
+    #
+    # * lease (cfg.read_lease): store the RECEIPT tick, gated by the echo
+    #   freshness bound `now - aer_tick <= read_fresh_ticks`.  Receipt
+    #   anchoring is stall-safe by the fault model itself: in-flight
+    #   messages addressed to a stalled node are LOST, so anything in the
+    #   inbox was sent one live tick ago and the follower processed our
+    #   AE at most `read_fresh_ticks - 1` global ticks before receipt
+    #   (duplicate-delivery chains add one tick each and require the
+    #   receiver awake every hop, so the freshness bound caps them at one
+    #   hop).  Term monotonicity then closes the proof: a write acked by
+    #   a newer-term leader before a batch's stamp needs a majority at
+    #   the newer term strictly earlier, which must intersect our
+    #   same-term evidence majority — a node cannot return to an older
+    #   term.  No clock-drift assumption anywhere.
+    # * strict ReadIndex: store the ECHOED send tick, so release requires
+    #   acks to heartbeats SENT at/after the stamp (the textbook
+    #   dedicated confirmation round) — sound under arbitrary transport
+    #   delay, one round trip slower.
+    #
+    # host.read_veto (host runtime detected a wall-clock tick gap) drops
+    # stored AND same-tick evidence: a paused host's inbox may hold acks
+    # queued before the pause, which receipt anchoring must not trust.
+    read_evid = s.read_evid
+    if cfg.read_lease:
+        evid_hit = aer_r & ~self_hot & \
+            (now - inbox.aer_tick.T <= cfg.read_fresh_ticks)
+        evid_val = jnp.broadcast_to(now, (G, P))
+    else:
+        evid_hit = aer_r & ~self_hot
+        evid_val = jnp.maximum(read_evid, inbox.aer_tick.T)
+    read_evid = jnp.where(evid_hit, evid_val, read_evid)
+    read_evid = jnp.where(host.read_veto, jnp.zeros_like(read_evid),
+                          read_evid)
+
     # Snapshot response: success means the follower now covers our offered
     # milestone — resume log replication from just past our floor (reference
     # accomplishInstallation -> normal AppendEntries flow,
@@ -549,6 +598,55 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     app_from = jnp.where((n_acc > 0) & (app_from == 0), sub_start, app_from)
     app_to = jnp.where(n_acc > 0, log.last, app_to)
 
+    # ---- 8b. linearizable read plane: intake + barrier release ------------
+    # ReadIndex (Raft dissertation §6.4), vectorized: a read batch is
+    # STAMPED with the leader's current commit index and RELEASED once a
+    # majority confirms our leadership at/after the stamp (evidence from
+    # phase 6b) — reads never touch the log.  Stamping with the
+    # pre-phase-10 commit is sound: a write acknowledged to any client
+    # before this tick was committed by the end of an earlier tick, so
+    # the carried-in commit already covers it.
+    from ..ops.quorum import read_barrier_release
+    K = cfg.read_slots
+    # Pending reads live only within one continuous leadership at one
+    # term: any role/term change drops them (the host fails them with
+    # NotLeader; reads never enter the log, so the retry is always safe).
+    keep_reads = active & (role == LEADER) & (term == s.term)
+    read_abort = (s.rq_len > 0) & ~keep_reads
+    rq_head = jnp.where(keep_reads, s.rq_head, 0)
+    rq_len = jnp.where(keep_reads, s.rq_len, 0)
+    read_evid = jnp.where(keep_reads[:, None], read_evid, 0)
+    rq_idx, rq_stamp, rq_n = s.rq_idx, s.rq_stamp, s.rq_n
+    # Intake: one offered batch per group per tick, accepted whole when a
+    # FIFO slot is free and our §8 no-op has committed (commit >= own_from
+    # — a fresh leader's commit index may lag entries committed by its
+    # predecessors until its own-term entry commits, Raft §5.4.2; serving
+    # before that could miss them).
+    n_read = jnp.where(keep_reads & (commit >= own_from) & (rq_len < K),
+                       jnp.maximum(host.read_n, 0), 0)
+    read_acc = n_read > 0
+    rows_g = jnp.arange(G, dtype=I32)
+    slot_in = jnp.where(read_acc, jnp.remainder(rq_head + rq_len, K), K)
+    rq_idx = rq_idx.at[rows_g, slot_in].set(commit, mode="drop")
+    rq_stamp = rq_stamp.at[rows_g, slot_in].set(now, mode="drop")
+    rq_n = rq_n.at[rows_g, slot_in].set(n_read, mode="drop")
+    rq_len = rq_len + read_acc.astype(I32)
+    read_index_out = jnp.where(read_acc, commit, 0)
+    # Release (ops/quorum.py): with the lease, evidence received THIS
+    # tick carries receipt == now == the fresh batch's stamp, so a
+    # heartbeat-ack burst releases a same-tick read with zero extra round
+    # trips — the lease fast path IS the general rule at its freshness
+    # limit.  Strict mode can only release on a later tick's echo.
+    n_rel, n_served = read_barrier_release(
+        maj, read_evid, rq_stamp, rq_head, rq_len, rq_n)
+    rq_head = jnp.remainder(rq_head + n_rel, K)
+    rq_len = rq_len - n_rel
+    read_lease_hit = read_acc & (n_rel > 0) & (rq_len == 0)
+    # A batch left pending kicks an immediate barrier heartbeat (phase 9)
+    # instead of waiting out the cadence: release latency is one round
+    # trip, not heartbeat_ticks + one round trip.
+    read_kick = read_acc & (rq_len > 0)
+
     # ---- 9. replication fan-out -------------------------------------------
     # (reference Leader.replicateLog:142-245 — the hot loop, now a dense
     # (group x peer) batch build straight from the HBM ring, pipelined up to
@@ -574,7 +672,7 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     inflight = jnp.where(timed_out, 0, inflight)
     hb_inflight = jnp.where(timed_out, 0, hb_inflight)
 
-    heartbeat = (role == LEADER) & (now >= hb_due)
+    heartbeat = (role == LEADER) & ((now >= hb_due) | read_kick)
     has_data = (log.last[:, None] >= send_next) & ~need_snap
     n_avail = jnp.clip(log.last[:, None] - send_next + 1, 0, B)  # [G, P]
     # Data flows whenever the window has room; empty heartbeat AEs keep
@@ -614,6 +712,8 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     out_ae_n = n_send.T
     out_ae_ents = jnp.swapaxes(ents_all, 0, 1)                   # [P, G, B]
     out_ae_occ = hb_occupy.T
+    # Send tick, echoed back as aer_tick (read-barrier evidence, 6b).
+    out_ae_tick = jnp.broadcast_to(now, (P, G)).astype(I32)
     # Snapshot offer for laggards (reference Leader.java:168-190); occupies
     # the whole window (one offer at a time), re-offered on the heartbeat
     # cadence while un-acked — the re-offer is window-exempt like a
@@ -707,6 +807,8 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         debug_viol = flag(debug_viol, commit < s.commit, 6)
         # 7: pipeline head behind the ack base.
         debug_viol = flag(debug_viol, (send_next < next_idx).any(axis=1), 7)
+        # 8: read FIFO length out of range.
+        debug_viol = flag(debug_viol, (rq_len < 0) | (rq_len > K), 8)
 
     new_state = RaftState(
         node_id=s.node_id, now=now, rng=rng, active=active,
@@ -719,15 +821,19 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         ok_at=ok_at, fail_at=fail_at, fail_streak=fail_streak,
         votes=votes, prevotes=prevotes,
         elect_deadline=elect_dl, hb_due=hb_due,
+        read_evid=read_evid,
+        rq_idx=rq_idx, rq_stamp=rq_stamp, rq_n=rq_n,
+        rq_head=rq_head, rq_len=rq_len,
     )
     outbox = Messages(
         ae_valid=out_ae_valid, ae_term=out_ae_term,
         ae_prev_idx=out_ae_prev_idx, ae_prev_term=out_ae_prev_term,
         ae_commit=out_ae_commit, ae_n=out_ae_n, ae_ents=out_ae_ents,
-        ae_occ=out_ae_occ,
+        ae_occ=out_ae_occ, ae_tick=out_ae_tick,
         aer_valid=out_aer_valid, aer_term=out_aer_term,
         aer_success=out_aer_success, aer_match=out_aer_match,
         aer_empty=out_aer_empty, aer_occ=out_aer_occ,
+        aer_tick=out_aer_tick,
         rv_valid=out_rv_valid, rv_term=out_rv_term,
         rv_last_idx=out_rv_last_idx, rv_last_term=out_rv_last_term,
         rv_prevote=out_rv_prevote,
@@ -745,6 +851,9 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         commit=commit, leader=leader_id, ready=ready, snap_req=snap_req,
         snap_req_from=snap_from, snap_req_idx=snap_idx_o,
         snap_req_term=snap_term_o, noop_idx=noop_idx, noop_term=noop_term,
+        read_acc=n_read, read_index=read_index_out,
+        read_rel=n_rel, read_served=n_served,
+        read_lease=read_lease_hit, read_abort=read_abort,
         debug_viol=debug_viol,
     )
     return new_state, outbox, info

@@ -95,6 +95,27 @@ class EngineConfig:
                                   #     Follower.java:48-50, Leadership.java:76-81,
                                   #     RocksLog.java:175-187).  Off by default:
                                   #     zero cost when False (trace-time branch).
+    # Linearizable read plane (ReadIndex + lease fast path; no reference
+    # analog — curioloop/rafting routes every read through the log).
+    read_slots: int = 4           # K — pending ReadIndex batches per group
+                                  #     (a per-group FIFO ring of stamped read
+                                  #     fences awaiting their quorum barrier)
+    read_lease: bool = True       # lease fast path: barrier evidence is
+                                  #     RECEIPT-anchored (a fresh same-term
+                                  #     heartbeat-ack quorum in this tick's
+                                  #     inbox releases a same-tick read — zero
+                                  #     extra round trips).  False = strict
+                                  #     ReadIndex: evidence is the ECHOED send
+                                  #     tick, so a read only releases on acks
+                                  #     to heartbeats SENT at/after its stamp
+                                  #     (a dedicated post-stamp confirmation
+                                  #     round; delay-proof, ~1 RTT slower).
+    read_fresh_ticks: int = 3     # lease evidence freshness: an ack older
+                                  #     than this many own-clock ticks past
+                                  #     its echoed send tick is not lease
+                                  #     evidence (bounds duplicate-delivery
+                                  #     chains to one hop — see step.py
+                                  #     read-barrier phase for the proof)
 
     def __post_init__(self):
         assert self.n_peers >= 1
@@ -104,6 +125,9 @@ class EngineConfig:
         assert self.rpc_timeout_ticks >= 1
         assert self.inflight_limit >= 1, "pipelining window needs >= 1 slot"
         assert self.avail_crit >= 0 and self.recovery_ticks >= 0
+        assert self.read_slots >= 1, "read plane needs >= 1 pending slot"
+        assert self.read_fresh_ticks >= 2, \
+            "lease evidence needs the 2-tick delivery round trip"
 
     @property
     def majority(self) -> int:
@@ -192,6 +216,25 @@ class RaftState:
     elect_deadline: jax.Array # [G] int32 — election timer deadline (tick)
     hb_due: jax.Array         # [G] int32 — next heartbeat tick (leader)
 
+    # Linearizable read plane (leader-only lanes; ReadIndex §6.4 of the
+    # Raft dissertation, vectorized).  A read batch is STAMPED with the
+    # leader's commit index at receipt and RELEASED once a majority has
+    # confirmed our leadership at/after the stamp and (host-side) the
+    # apply frontier covers the stamp.  All comparisons are between two
+    # values of the SAME node's own clock, so per-node clock drift under
+    # nemesis stalls cannot skew them (see step.py read-barrier phase).
+    read_evid: jax.Array      # [G, P] int32 — barrier evidence per peer:
+                              #   with cfg.read_lease, the own-clock RECEIPT
+                              #   tick of the last fresh same-term AE ack;
+                              #   without, the ECHOED send tick (aer_tick) —
+                              #   acks to heartbeats sent at/after a stamp.
+                              #   0 = none this leadership.
+    rq_idx: jax.Array         # [G, K] int32 — pending batch read indices
+    rq_stamp: jax.Array       # [G, K] int32 — pending batch stamp ticks
+    rq_n: jax.Array           # [G, K] int32 — reads per pending batch
+    rq_head: jax.Array        # [G] int32 — FIFO ring head slot
+    rq_len: jax.Array         # [G] int32 — pending batch count (<= K)
+
 
 @struct.dataclass
 class FaultSchedule:
@@ -261,6 +304,7 @@ def crash_restart(cfg: EngineConfig, s: "RaftState") -> "RaftState":
     crash mask), so un-crashed nodes keep their stream bit-exactly.
     """
     G, P = cfg.n_groups, cfg.n_peers
+    K = cfg.read_slots
     rng, k = jax.random.split(s.rng)
     deadline = s.now + jax.random.randint(
         k, (G,), cfg.election_ticks, 2 * cfg.election_ticks, dtype=I32)
@@ -288,6 +332,12 @@ def crash_restart(cfg: EngineConfig, s: "RaftState") -> "RaftState":
         prevotes=f(G, P),
         elect_deadline=deadline,
         hb_due=z(G),
+        # Pending reads are volatile leader state: a restart drops them
+        # (clients retry — reads never enter the log, so the retry is
+        # always safe) and barrier evidence must be re-earned.
+        read_evid=z(G, P),
+        rq_idx=z(G, K), rq_stamp=z(G, K), rq_n=z(G, K),
+        rq_head=z(G), rq_len=z(G),
     )
 
 
@@ -318,6 +368,12 @@ class Messages:
                              #   heartbeats release hb_inflight (a reply to a
                              #   window-full EXEMPT heartbeat must not free a
                              #   slot whose own ack was lost — ADVICE r4)
+    ae_tick: jax.Array       # [P, G] int32 — sender's own clock at send,
+                             #   echoed back as aer_tick: the read plane's
+                             #   barrier-evidence anchor (strict ReadIndex
+                             #   compares the echo against the read stamp;
+                             #   the lease path uses it as a freshness bound
+                             #   on duplicate-delivery chains)
 
     # AppendEntries response (reference RaftResponse + match bookkeeping)
     aer_valid: jax.Array     # [P, G] bool
@@ -331,6 +387,7 @@ class Messages:
     aer_occ: jax.Array       # [P, G] bool — echo of the AE's ae_occ flag
                              #   (meaningful with aer_empty; symmetric with
                              #   is_probe/isr_probe)
+    aer_tick: jax.Array      # [P, G] int32 — echo of ae_tick (read barrier)
 
     # RequestVote / PreVote request (reference Follower.prepareElection,
     # Candidate.startElection)
@@ -372,9 +429,10 @@ class Messages:
         return cls(
             ae_valid=f(P, G), ae_term=z(P, G), ae_prev_idx=z(P, G),
             ae_prev_term=z(P, G), ae_commit=z(P, G), ae_n=z(P, G),
-            ae_ents=z(P, G, B), ae_occ=f(P, G),
+            ae_ents=z(P, G, B), ae_occ=f(P, G), ae_tick=z(P, G),
             aer_valid=f(P, G), aer_term=z(P, G), aer_success=f(P, G),
             aer_match=z(P, G), aer_empty=f(P, G), aer_occ=f(P, G),
+            aer_tick=z(P, G),
             rv_valid=f(P, G), rv_term=z(P, G), rv_last_idx=z(P, G),
             rv_last_term=z(P, G), rv_prevote=f(P, G),
             rvr_valid=f(P, G), rvr_term=z(P, G), rvr_granted=f(P, G),
@@ -400,6 +458,16 @@ class HostInbox:
     # the log floor (reference RaftRoutine.compactLog:365-400).  The milestone
     # term is read from the device-side ring, so only the index is needed.
     compact_to: jax.Array      # [G] int32 (0 = no-op)
+    # Linearizable read plane.
+    read_n: jax.Array          # [G] int32 — linearizable reads offered this
+                               #   tick (one batch; stamped together when a
+                               #   pending slot is free and the lane leads)
+    read_veto: jax.Array       # scalar bool — host detected a wall-clock
+                               #   tick gap (process pause): discard stored
+                               #   and same-tick lease evidence so a pause
+                               #   cannot stretch the lease window (the host
+                               #   analog of the device model's
+                               #   stall-loses-inbound rule)
 
     @classmethod
     def empty(cls, cfg: EngineConfig) -> "HostInbox":
@@ -410,6 +478,8 @@ class HostInbox:
             snap_idx=jnp.zeros((G,), I32),
             snap_term=jnp.zeros((G,), I32),
             compact_to=jnp.zeros((G,), I32),
+            read_n=jnp.zeros((G,), I32),
+            read_veto=jnp.asarray(False),
         )
 
 
@@ -446,6 +516,25 @@ class StepInfo:
                               #   term; carried explicitly so a later-phase
                               #   term bump in the same tick cannot skew the
                               #   staged record)
+    # Linearizable read plane (host pairs these with its own FIFO mirror
+    # of offered read batches — acceptance and release are reported as
+    # counts, in FIFO order).
+    read_acc: jax.Array       # [G] int32 — reads accepted into the batch
+                              #   stamped this tick (0 = offer not taken)
+    read_index: jax.Array     # [G] int32 — the stamped batch's ReadIndex
+                              #   (meaningful when read_acc > 0): serve once
+                              #   applied >= read_index
+    read_rel: jax.Array       # [G] int32 — batches RELEASED this tick
+                              #   (leadership confirmed at/after their stamp;
+                              #   FIFO from the oldest pending)
+    read_served: jax.Array    # [G] int32 — individual reads in those batches
+    read_lease: jax.Array     # [G] bool — the batch stamped THIS tick was
+                              #   released same-tick by the lease fast path
+                              #   (zero extra round trips)
+    read_abort: jax.Array     # [G] bool — pending read batches dropped
+                              #   (leadership/term changed); the host fails
+                              #   them with NotLeader — clients retry safely
+                              #   (reads never enter the log)
     debug_viol: jax.Array     # [G] int32 — in-kernel invariant violation code
                               #   (0 = ok; codes in step.py DEBUG_CODES).
                               #   Always zeros unless cfg.debug_checks.
@@ -463,6 +552,9 @@ class StepInfo:
             snap_req=jnp.zeros((G,), jnp.bool_),
             snap_req_from=z(), snap_req_idx=z(), snap_req_term=z(),
             noop_idx=z(), noop_term=z(),
+            read_acc=z(), read_index=z(), read_rel=z(), read_served=z(),
+            read_lease=jnp.zeros((G,), jnp.bool_),
+            read_abort=jnp.zeros((G,), jnp.bool_),
             debug_viol=z(),
         )
 
@@ -475,7 +567,7 @@ def init_state(cfg: EngineConfig, node_id: int, seed: int = 0,
     timeout, seeded per node — the vectorized analog of the reference's
     randomized election window (support/RaftConfig.java:187-190).
     """
-    G, P = cfg.n_groups, cfg.n_peers
+    G, P, K = cfg.n_groups, cfg.n_peers, cfg.read_slots
     key = jax.random.PRNGKey(seed * 7919 + node_id)
     key, sub = jax.random.split(key)
     first_deadline = jax.random.randint(
@@ -509,4 +601,7 @@ def init_state(cfg: EngineConfig, node_id: int, seed: int = 0,
         prevotes=jnp.zeros((G, P), jnp.bool_),
         elect_deadline=first_deadline,
         hb_due=z(G),
+        read_evid=z(G, P),
+        rq_idx=z(G, K), rq_stamp=z(G, K), rq_n=z(G, K),
+        rq_head=z(G), rq_len=z(G),
     )

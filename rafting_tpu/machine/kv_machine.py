@@ -54,6 +54,16 @@ class KVMachine:
         self._last_applied = index
         return result
 
+    def read(self, payload: bytes) -> Any:
+        """Linearizable query (machine/spi.py read SPI): same JSON command
+        vocabulary as apply, restricted to the read-only op — served off
+        the log by the read plane once the apply frontier covers the
+        quorum-confirmed ReadIndex."""
+        cmd = json.loads(payload)
+        if cmd.get("op") != "get":
+            raise ValueError(f"read supports op=get only, got {cmd.get('op')!r}")
+        return self.data.get(cmd["k"])
+
     def _dump(self, path: str) -> None:
         tmp = path + ".tmp"
         with open(tmp, "w") as f:

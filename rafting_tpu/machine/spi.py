@@ -60,6 +60,16 @@ class RaftMachine(Protocol):
       pays ZERO per-entry materialization.  Same shorter-prefix failure
       contract as ``apply_batch``, and the same caution about
       overriding ``apply``.
+    * :meth:`read` (optional): serve a LINEARIZABLE QUERY against current
+      machine state without going through the log (the read plane,
+      core/step.py phase 8b: the runtime only calls this once the group's
+      apply frontier covers the query's quorum-confirmed ReadIndex).
+      Must not mutate state.  Called on the tick thread (the same
+      single-writer thread as ``apply``), so no extra locking is needed.
+      A machine WITHOUT ``read`` still gets linearizable reads: the
+      runtime resolves the read future with the ReadIndex itself (the
+      linearization point), which callers can pair with their own state
+      access.
     """
 
     applies_empty: bool = False

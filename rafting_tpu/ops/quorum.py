@@ -145,6 +145,43 @@ def quorum_commit_pallas(match_full, own_from, state_vec,
     return out.reshape(Gp)[:G]
 
 
+# ------------------------------------------------------------ read barrier --
+
+def read_barrier_release(majority: int, read_evid, rq_stamp, rq_head,
+                         rq_len, rq_n):
+    """ReadIndex barrier for every group at once: how many pending read
+    batches (FIFO from ``rq_head``) have a confirmed leadership quorum.
+
+    A batch stamped at tick ``s`` releases once ``1 + #{p : read_evid[g, p]
+    >= s} >= majority`` — the leader itself plus peers whose barrier
+    evidence (core/step.py read-barrier phase: ack receipt tick under the
+    lease, echoed send tick under strict ReadIndex) postdates the stamp.
+    Release is prefix-monotone by construction — stamps increase along the
+    FIFO and evidence is a per-peer maximum, so a releasable batch implies
+    every older one is releasable — but the cumulative-AND guard below
+    keeps FIFO order even if a caller hands in unordered stamps.
+
+    Returns ``(n_rel [G] int32, n_served [G] int32)``: batches released
+    and the total individual reads inside them.  This lives beside the
+    commit kernel because it is the same shape of op — a quorum order
+    statistic over the peer axis feeding a masked monotone update — and
+    the Pallas treatment, if ever needed, would tile identically.
+    """
+    G, K = rq_stamp.shape
+    j = jnp.arange(K, dtype=I32)[None, :]                       # FIFO pos
+    slot = jnp.remainder(rq_head[:, None] + j, K)               # [G, K]
+    st = jnp.take_along_axis(rq_stamp, slot, axis=1)
+    n = jnp.take_along_axis(rq_n, slot, axis=1)
+    pending = j < rq_len[:, None]
+    # Evidence 0 means "none this leadership"; stamps are >= 1 (the tick
+    # clock starts at 1), so the comparison needs no extra guard.
+    peer_ok = read_evid[:, None, :] >= st[:, :, None]           # [G, K, P]
+    cnt = 1 + peer_ok.sum(axis=2).astype(I32)                   # self counts
+    ok = pending & (cnt >= majority)
+    rel = pending & (jnp.cumsum((~ok).astype(I32), axis=1) == 0)
+    return rel.sum(axis=1).astype(I32), (rel * n).sum(axis=1).astype(I32)
+
+
 def quorum_commit(cfg, match_full, log, commit, own_from, can_lead):
     """Dispatch: Pallas when ``cfg.use_pallas``, else inline jnp (the
     default; both paths are semantically identical)."""

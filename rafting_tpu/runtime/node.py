@@ -172,6 +172,25 @@ class _SubBatch:
         self.taken = 0
 
 
+class _ReadBatch:
+    """One queued linearizable read batch: query payloads + promise sink.
+
+    Read batches move through four host stages mirroring the device FIFO
+    (core/types.py rq_* lanes): WAITING (client-enqueued) -> OFFERED
+    (this tick's HostInbox.read_n) -> PENDING (device stamped it with a
+    ReadIndex; awaiting the quorum barrier) -> RELEASED (barrier
+    confirmed; served once ``applied >= read_index``).  Unlike
+    submissions, a read batch is atomic — the device stamps it whole or
+    not at all — so there is no ``taken`` cursor."""
+
+    __slots__ = ("payloads", "sink", "t_enq")
+
+    def __init__(self, payloads, sink: BatchSubmit, t_enq: float):
+        self.payloads = payloads
+        self.sink = sink
+        self.t_enq = t_enq
+
+
 class RaftNode:
     def __init__(self, cfg: EngineConfig, node_id: int, data_dir: str,
                  provider: MachineProvider,
@@ -283,6 +302,32 @@ class RaftNode:
         self.total_queue_cap = total_queue_cap
         self.busy_threshold = busy_threshold   # free slots -> BusyLoopError
 
+        # Linearizable read plane (ReadIndex + lease, core/step.py phase
+        # 8b): the host-side FIFO mirror of the device's rq_* lanes.  A
+        # batch is WAITING until the group's offer slot frees, OFFERED for
+        # exactly the ticks its HostInbox.read_n is up, PENDING once the
+        # device stamps it (StepInfo.read_acc/read_index), RELEASED once
+        # the quorum barrier confirms (read_rel, FIFO order), and served —
+        # the machine queried at ``applied >= read_index`` — on the tick
+        # thread.  Reads never enter the log, so EVERY read failure is a
+        # marked retry-safe refusal (api/anomaly.py as_refusal).
+        self._read_lock = threading.Lock()
+        self._reads_waiting: Dict[int, deque] = {}
+        self._reads_offered: Dict[int, _ReadBatch] = {}
+        self._reads_pending: Dict[int, deque] = {}   # (read_index, batch)
+        self._reads_released: Dict[int, deque] = {}  # (read_index, batch)
+        self._read_queued_n = np.zeros(G, np.int32)
+        # Wall-clock pause detection feeding HostInbox.read_veto: a tick
+        # gap longer than read_fresh_ticks intervals means stored lease
+        # evidence (and anything queued in the inbox across the pause) is
+        # stale — the host analog of the device fault model's
+        # stall-loses-inbound rule.  Armed only when the tick loop runs on
+        # a real cadence (start(); manual tick() drivers have no
+        # wall-clock meaning).
+        self._tick_interval: Optional[float] = None
+        self._last_tick_wall: Optional[float] = None
+        self._read_veto_hold = 0   # ticks of veto left after a pause
+
         # Snapshot downloads: a BOUNDED global worker pool fetches bytes to
         # temp files (reference: ONE dedicated snapshot NIO thread,
         # transport/NettyCluster.java:42-43 — thread-per-lagging-group
@@ -357,6 +402,7 @@ class RaftNode:
         """Run the tick loop in a background thread (the node's
         'event loop'; interval plays the reference's tick,
         support/RaftConfig.java:171-185)."""
+        self._tick_interval = tick_interval
         self.transport.start()
         self._thread = threading.Thread(
             target=self._run, args=(tick_interval,),
@@ -534,6 +580,50 @@ class RaftNode:
                 headroom -= n
         return sinks
 
+    def read(self, group: int, payload: bytes) -> Future:
+        """Linearizable read: resolve with the machine's ``read(payload)``
+        result (or, for machines without the read SPI, the quorum-confirmed
+        ReadIndex itself) WITHOUT appending to the log.
+
+        Protocol (ReadIndex, Raft dissertation §6.4, vectorized in
+        core/step.py phase 8b): the device stamps the batch with the
+        leader's commit index, confirms leadership via a majority of
+        same-term heartbeat acks (receipt-anchored when cfg.read_lease —
+        often zero extra round trips — else echo-anchored, one round
+        trip), and the host serves it once the apply frontier covers the
+        stamp.  Every failure of a read future is a MARKED refusal
+        (api/anomaly.py): a read never enters any log, so retrying it
+        elsewhere is always safe — unlike submit's accept-abort ambiguity.
+        """
+        return self.read_batch(group, [payload], _single=True)
+
+    def read_batch(self, group: int, payloads,
+                   _single: bool = False) -> Future:
+        """Offer many linearizable queries as ONE read batch with one
+        future resolving to the list of results in order.  The whole batch
+        shares one ReadIndex barrier — the amortization the read plane
+        exists for.  Same refusal taxonomy as :meth:`submit_batch`, but
+        every refusal/abort is retry-safe (see :meth:`read`)."""
+        sink = BatchSubmit(len(payloads), single=_single)
+        fut = sink.future
+        err = self._refusal(group)
+        if err is not None:
+            fut.set_exception(err)
+            return fut
+        if not payloads:
+            fut.set_result([])
+            return fut
+        n = len(payloads)
+        with self._read_lock:
+            if int(self._read_queued_n[group]) + n > self.group_queue_cap:
+                fut.set_exception(as_refusal(BusyLoopError(
+                    f"group {group}: read queue full")))
+                return fut
+            self._reads_waiting.setdefault(group, deque()).append(
+                _ReadBatch(list(payloads), sink, time.monotonic()))
+            self._read_queued_n[group] += n
+        return fut
+
     def _refusal(self, group: int) -> Optional[Exception]:
         """The submission refusal taxonomy, shared by submit/submit_batch
         (reference: RaftStub.process checks, command/RaftStub.java:79-91).
@@ -638,6 +728,11 @@ class RaftNode:
                         g, ObsoleteContextError(f"group {g} closed"))
                     self._reject_submissions(
                         g, ObsoleteContextError(f"group {g} closed"))
+                    # Reads too — including barrier-confirmed ones: the
+                    # machine they would query is going away.
+                    self._reject_reads(
+                        g, ObsoleteContextError(f"group {g} closed"),
+                        drop_released=True)
                 if purge:
                     purged.append(g)
             self.state = self.state.replace(active=jnp.asarray(act))
@@ -650,6 +745,38 @@ class RaftNode:
             # One vector op over the entry-count mirror — the dict walk
             # was O(groups-with-queues) per tick.
             submit_n = np.minimum(self._queued_n, cfg.max_submit)
+        # Read plane: promote one waiting batch per group into the offer
+        # slot; an unstamped offer (no free device slot / not leader yet)
+        # simply stays offered and is re-offered next tick.
+        read_n = np.zeros(G, np.int32)
+        with self._read_lock:
+            for g, q in self._reads_waiting.items():
+                if q and g not in self._reads_offered:
+                    b = q.popleft()
+                    self._read_queued_n[g] -= len(b.payloads)
+                    self._reads_offered[g] = b
+            for g, b in self._reads_offered.items():
+                read_n[g] = len(b.payloads)
+        # Wall-clock pause detection (HostInbox.read_veto contract): a gap
+        # beyond read_fresh_ticks tick intervals invalidates stored lease
+        # evidence AND whatever acks queued in the inbox across the pause.
+        # The veto is HELD for read_fresh_ticks consecutive ticks, not one:
+        # pause-era acks still sitting in socket buffers drain through the
+        # reader threads into the accumulator over the FOLLOWING ticks too,
+        # and a single-tick veto would let receipt-anchored lease evidence
+        # resurrect from them one tick later (the tick clock did not
+        # advance during the pause, so the freshness bound alone cannot
+        # reject them).
+        wall = time.monotonic()
+        if self._tick_interval and self._last_tick_wall is not None:
+            gap = wall - self._last_tick_wall
+            if gap > self._tick_interval * max(cfg.read_fresh_ticks, 2):
+                self._read_veto_hold = max(cfg.read_fresh_ticks, 2)
+                self.metrics["read_vetoes"] += 1
+        read_veto = self._read_veto_hold > 0
+        if read_veto:
+            self._read_veto_hold -= 1
+        self._last_tick_wall = wall
         snap_done = np.zeros(G, bool)
         snap_idx = np.zeros(G, np.int32)
         snap_term = np.zeros(G, np.int32)
@@ -665,6 +792,8 @@ class RaftNode:
             snap_idx=jnp.asarray(snap_idx),
             snap_term=jnp.asarray(snap_term),
             compact_to=jnp.asarray(self._compact_grant.astype(np.int32)),
+            read_n=jnp.asarray(read_n),
+            read_veto=jnp.asarray(read_veto),
         )
         self._compact_grant = np.zeros(G, np.int64)
 
@@ -718,6 +847,11 @@ class RaftNode:
             self.dispatcher.abort_promises(
                 g, NotLeaderError(g, self.leader_hint(g)))
             self._reject_submissions(g)
+            # Un-served reads fail as RETRY-SAFE refusals (they never
+            # entered the log); batches that already passed their barrier
+            # (RELEASED) stay — a confirmed ReadIndex remains a valid
+            # linearization point under any later leadership.
+            self._reject_reads(g)
 
         # -- 4. persistence barrier ------------------------------------------
         self._persist(h_info, h_term, h_voted, h_leader, h_base, h_base_term,
@@ -732,6 +866,10 @@ class RaftNode:
         after = self.dispatcher.applied_frontier(G)
         self.metrics["applies"] += int((after - before).sum())
         self.metrics["commits"] = int(h_commit.astype(np.int64).sum())
+
+        # -- 6b. read plane: stamped/released bookkeeping + serving ----------
+        self._harvest_reads(h_info)
+        self._serve_reads(after)
 
         # -- 7. maintain: checkpoints, compaction, snapshot downloads --------
         self._maintain(after, h_base, h_term)
@@ -986,6 +1124,103 @@ class RaftNode:
         for b in q:
             b.sink._fail(err)
 
+    # ------------------------------------------------------------ read plane
+
+    def _harvest_reads(self, info: StepInfo) -> None:
+        """Tick thread: mirror the device read FIFO's transitions reported
+        in StepInfo — offered batches the device STAMPED move to pending
+        with their ReadIndex; pending batches whose barrier RELEASED move
+        to released (FIFO, exactly read_rel of them); device-side ABORTS
+        (leadership/term change dropped the whole FIFO) fail every
+        un-served batch as a retry-safe refusal."""
+        read_acc = np.asarray(info.read_acc)
+        read_idx = np.asarray(info.read_index)
+        read_rel = np.asarray(info.read_rel)
+        read_abort = np.asarray(info.read_abort)
+        self.metrics["read_lease_hits"] += int(
+            np.asarray(info.read_lease).sum())
+        with self._read_lock:
+            for g in np.nonzero(read_acc > 0)[0].tolist():
+                b = self._reads_offered.pop(g, None)
+                # The device stamps exactly the offered batch, whole (its
+                # intake reads HostInbox.read_n built from this mirror) —
+                # a mismatch means the FIFOs desynchronized, the read
+                # analog of the submit queue-depth invariant.
+                assert b is not None and int(read_acc[g]) == len(b.payloads), \
+                    (f"g={g}: device stamped {int(read_acc[g])} reads "
+                     "beyond the offered batch")
+                self._reads_pending.setdefault(g, deque()).append(
+                    (int(read_idx[g]), b))
+            for g in np.nonzero(read_rel > 0)[0].tolist():
+                q = self._reads_pending.get(g)
+                rel = self._reads_released.setdefault(g, deque())
+                for _ in range(int(read_rel[g])):
+                    assert q, (f"g={g}: device released a read batch the "
+                               "host FIFO does not hold")
+                    rel.append(q.popleft())
+        for g in np.nonzero(read_abort)[0].tolist():
+            self._reject_reads(int(g))
+
+    def _serve_reads(self, applied: np.ndarray) -> None:
+        """Tick thread: serve released batches whose ReadIndex the apply
+        frontier covers.  Machine ``read`` runs here — the same
+        single-writer thread as applies, so queries see a consistent
+        machine with no extra locking (machine/spi.py read SPI)."""
+        ready: List[Tuple[int, int, _ReadBatch]] = []
+        with self._read_lock:
+            for g in list(self._reads_released):
+                q = self._reads_released[g]
+                a = int(applied[g])
+                while q and q[0][0] <= a:
+                    idx, b = q.popleft()
+                    ready.append((g, idx, b))
+                if not q:
+                    del self._reads_released[g]
+        if not ready:
+            return
+        now = time.monotonic()
+        for g, idx, b in ready:
+            machine = self.dispatcher.machine(g)
+            rd = getattr(machine, "read", None)
+            try:
+                for k, payload in enumerate(b.payloads):
+                    b.sink._complete(k, idx if rd is None else rd(payload))
+            except Exception as e:
+                # Query errors are still retry-safe: the read mutated
+                # nothing (SPI contract) and never entered the log.
+                b.sink._fail(as_refusal(e))
+                continue
+            self.metrics["reads_served"] += len(b.payloads)
+            self.metrics.observe("read_barrier_latency_s", now - b.t_enq)
+
+    def _reject_reads(self, g: int, exc: Optional[Exception] = None,
+                      drop_released: bool = False) -> None:
+        """Fail every un-served read batch for ``g`` (waiting + offered +
+        pending; ``drop_released`` adds barrier-confirmed batches too —
+        only lane close/purge does that, since a confirmed ReadIndex stays
+        servable across leadership changes).  Always a MARKED refusal:
+        reads never enter the log, so any retry is safe."""
+        with self._read_lock:
+            q = self._reads_waiting.pop(g, None)
+            batches = list(q) if q else []
+            b = self._reads_offered.pop(g, None)
+            if b is not None:
+                batches.append(b)
+            pend = self._reads_pending.pop(g, None)
+            if pend:
+                batches.extend(bb for _, bb in pend)
+            if drop_released:
+                rel = self._reads_released.pop(g, None)
+                if rel:
+                    batches.extend(bb for _, bb in rel)
+            self._read_queued_n[g] = 0
+        if not batches:
+            return
+        err = as_refusal(exc or NotLeaderError(g, self.leader_hint(g)))
+        for b in batches:
+            b.sink._fail(err)
+        self.metrics["read_batches_aborted"] += len(batches)
+
     def _purge_lanes(self, lanes: List[int]) -> None:
         """Wipe destroyed lanes end to end: durable WAL state, machine,
         archived snapshots, and every device-side lane (term, log, vote,
@@ -1032,6 +1267,12 @@ class RaftNode:
             fail_streak=s.fail_streak.at[idx].set(0),
             votes=s.votes.at[idx].set(False),
             prevotes=s.prevotes.at[idx].set(False),
+            read_evid=s.read_evid.at[idx].set(0),
+            rq_idx=s.rq_idx.at[idx].set(0),
+            rq_stamp=s.rq_stamp.at[idx].set(0),
+            rq_n=s.rq_n.at[idx].set(0),
+            rq_head=s.rq_head.at[idx].set(0),
+            rq_len=s.rq_len.at[idx].set(0),
         )
         # device_get arrays may be read-only views; replace, don't mutate
         hc = np.array(self.h_commit)

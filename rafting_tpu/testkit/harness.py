@@ -90,13 +90,15 @@ class LocalCluster:
                                     snapshot_provider,
                                     submit_handler=node.submit,
                                     result_encoder=node.serializer
-                                    .encode_result)
+                                    .encode_result,
+                                    read_handler=node.read)
             return LoopbackTransport(self.net, node_id, self.cfg,
                                      node.template, on_slice,
                                      snapshot_provider,
                                      submit_handler=node.submit,
                                      result_encoder=node.serializer
-                                     .encode_result)
+                                     .encode_result,
+                                     read_handler=node.read)
         return build
 
     def start_node(self, i: int) -> RaftNode:

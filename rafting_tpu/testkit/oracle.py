@@ -110,6 +110,13 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     prevotes = s["prevotes"].copy()
     elect_dl = s["elect_deadline"].copy()
     hb_due = s["hb_due"].copy()
+    read_evid = s["read_evid"].copy()
+    rq_idx = s["rq_idx"].copy()
+    rq_stamp = s["rq_stamp"].copy()
+    rq_n = s["rq_n"].copy()
+    rq_head = s["rq_head"].copy()
+    rq_len = s["rq_len"].copy()
+    K = cfg.read_slots
 
     old_term = term.copy()
     old_voted = voted.copy()
@@ -125,10 +132,10 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     out = {
         "ae_valid": zb(P, G), "ae_term": zi(P, G), "ae_prev_idx": zi(P, G),
         "ae_prev_term": zi(P, G), "ae_commit": zi(P, G), "ae_n": zi(P, G),
-        "ae_ents": zi(P, G, B), "ae_occ": zb(P, G),
+        "ae_ents": zi(P, G, B), "ae_occ": zb(P, G), "ae_tick": zi(P, G),
         "aer_valid": zb(P, G), "aer_term": zi(P, G),
         "aer_success": zb(P, G), "aer_match": zi(P, G),
-        "aer_empty": zb(P, G), "aer_occ": zb(P, G),
+        "aer_empty": zb(P, G), "aer_occ": zb(P, G), "aer_tick": zi(P, G),
         "rv_valid": zb(P, G), "rv_term": zi(P, G), "rv_last_idx": zi(P, G),
         "rv_last_term": zi(P, G), "rv_prevote": zb(P, G),
         "rvr_valid": zb(P, G), "rvr_term": zi(P, G), "rvr_granted": zb(P, G),
@@ -146,6 +153,9 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         "snap_req": zb(G), "snap_req_from": zi(G), "snap_req_idx": zi(G),
         "snap_req_term": zi(G),
         "noop_idx": zi(G), "noop_term": zi(G),
+        "read_acc": zi(G), "read_index": zi(G),
+        "read_rel": zi(G), "read_served": zi(G),
+        "read_lease": zb(G), "read_abort": zb(G),
     }
 
     for g in range(G):
@@ -314,6 +324,10 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
                 # against its window, so the reply must not decrement it.
                 out["aer_empty"][p, g] = int(ib["ae_n"][p, g]) == 0
                 out["aer_occ"][p, g] = bool(ib["ae_occ"][p, g])
+                # Send-tick echo (read-barrier evidence; kernel phase 4
+                # echoes it on success AND failure — any same-term reply
+                # proves the AE was processed).
+                out["aer_tick"][p, g] = ib["ae_tick"][p, g]
 
         # ---- 5. InstallSnapshot -------------------------------------------
         # (reference Follower.installSnapshot:130-153 + host completion,
@@ -409,6 +423,30 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
             # The pipeline head never trails the ack base.
             send_next[g, p] = max(send_next[g, p], next_idx[g, p])
 
+        # ---- 6b. read-barrier evidence ------------------------------------
+        # (kernel phase 6b: a same-term AE reply proves the sender followed
+        # us when it processed the AE.  Lease mode stores the RECEIPT tick
+        # gated by the echo freshness bound; strict mode stores the ECHOED
+        # send tick.)
+        for p in range(P):
+            if p == me:
+                continue
+            r = (bool(ib["aer_valid"][p, g]) and active[g]
+                 and role[g] == LEADER and int(ib["aer_term"][p, g]) == term[g])
+            if not r:
+                continue
+            echoed = int(ib["aer_tick"][p, g])
+            if cfg.read_lease:
+                if now - echoed <= cfg.read_fresh_ticks:
+                    read_evid[g, p] = now
+            else:
+                read_evid[g, p] = max(int(read_evid[g, p]), echoed)
+        if h["read_veto"]:
+            # Host detected a wall-clock tick gap: stored AND same-tick
+            # lease evidence is untrustworthy (kernel applies the same
+            # zeroing after the evidence store).
+            read_evid[g, :] = 0
+
         # ---- 7. timers -----------------------------------------------------
         # (reference Follower.onTimeout:156-168, Candidate.onTimeout:82-88.)
         start_pre = False
@@ -454,10 +492,50 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
             app_to = log.last
         info["submit_acc"][g] = n_acc
 
+        # ---- 8b. linearizable read plane: intake + barrier release --------
+        # (kernel phase 8b: stamp an offered batch with the current commit,
+        # release pending batches FIFO once a majority's barrier evidence
+        # postdates their stamp — mirrors ops/quorum.read_barrier_release.)
+        keep_reads = (active[g] and role[g] == LEADER
+                      and term[g] == old_term[g])
+        info["read_abort"][g] = int(rq_len[g]) > 0 and not keep_reads
+        if not keep_reads:
+            rq_head[g] = 0
+            rq_len[g] = 0
+            read_evid[g, :] = 0
+        n_read = 0
+        if (keep_reads and commit[g] >= own_from_a[g]
+                and int(rq_len[g]) < K):
+            n_read = max(0, int(h["read_n"][g]))
+        if n_read > 0:
+            slot = (int(rq_head[g]) + int(rq_len[g])) % K
+            rq_idx[g, slot] = commit[g]
+            rq_stamp[g, slot] = now
+            rq_n[g, slot] = n_read
+            rq_len[g] += 1
+            info["read_index"][g] = commit[g]
+        info["read_acc"][g] = n_read
+        n_rel, n_served = 0, 0
+        for j in range(int(rq_len[g])):
+            slot = (int(rq_head[g]) + j) % K
+            cnt = 1 + sum(int(read_evid[g, p]) >= int(rq_stamp[g, slot])
+                          for p in range(P))
+            if cnt < maj:
+                break   # FIFO: an unreleasable batch blocks younger ones
+            n_rel += 1
+            n_served += int(rq_n[g, slot])
+        rq_head[g] = (int(rq_head[g]) + n_rel) % K
+        rq_len[g] -= n_rel
+        info["read_rel"][g] = n_rel
+        info["read_served"][g] = n_served
+        info["read_lease"][g] = (n_read > 0 and n_rel > 0
+                                 and int(rq_len[g]) == 0)
+        read_kick = n_read > 0 and int(rq_len[g]) > 0
+
         # ---- 9. replication fan-out ---------------------------------------
         # (reference Leader.replicateLog:142-245 + prepareElection fan-out;
         # pipelined up to inflight_limit batches, Leadership.java:10-11.)
-        heartbeat = role[g] == LEADER and now >= hb_due[g]
+        heartbeat = role[g] == LEADER and (now >= hb_due[g] or read_kick)
         if active[g] and role[g] == LEADER:
             for p in range(P):
                 if p == me:
@@ -500,6 +578,7 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
                         else (log.ring[prev % L] if prev <= log.last else -1))
                     out["ae_commit"][p, g] = commit[g]
                     out["ae_n"][p, g] = n_send
+                    out["ae_tick"][p, g] = now
                     for k in range(B):
                         idx = int(send_next[g, p]) + k
                         out["ae_ents"][p, g, k] = (
@@ -603,5 +682,8 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         "ok_at": ok_at, "fail_at": fail_at, "fail_streak": fail_streak,
         "votes": votes, "prevotes": prevotes,
         "elect_deadline": elect_dl, "hb_due": hb_due,
+        "read_evid": read_evid,
+        "rq_idx": rq_idx, "rq_stamp": rq_stamp, "rq_n": rq_n,
+        "rq_head": rq_head, "rq_len": rq_len,
     }
     return new_state, out, info

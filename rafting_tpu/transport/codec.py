@@ -42,6 +42,10 @@ import numpy as np
 
 MAGIC = 0x54505552  # "RUPT"
 HELLO, MSGS, SNAP_REQ, SNAP_HDR, FWD_REQ, FWD_RESP = 1, 2, 3, 4, 5, 6
+# Linearizable-read forward: same body format as FWD_REQ/FWD_RESP, routed
+# to the serve side's read handler (RaftNode.read) instead of submit —
+# reads must execute on the leader but never enter the log.
+FWD_READ = 7
 
 MAX_BODY = 64 << 20  # 64 MB cap, matching the reference (EventCodec.java:26)
 
@@ -115,9 +119,10 @@ class PayloadRun:
 # order; dtypes/shapes come from the Messages template at pack/unpack time.
 KIND_FIELDS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "ae": ("ae_valid", ("ae_term", "ae_prev_idx", "ae_prev_term",
-                        "ae_commit", "ae_n", "ae_ents", "ae_occ")),
+                        "ae_commit", "ae_n", "ae_ents", "ae_occ",
+                        "ae_tick")),
     "aer": ("aer_valid", ("aer_term", "aer_success", "aer_match",
-                          "aer_empty", "aer_occ")),
+                          "aer_empty", "aer_occ", "aer_tick")),
     "rv": ("rv_valid", ("rv_term", "rv_last_idx", "rv_last_term",
                         "rv_prevote")),
     "rvr": ("rvr_valid", ("rvr_term", "rvr_granted", "rvr_prevote",
@@ -218,14 +223,15 @@ def unpack_snap_req(body: bytes) -> Tuple[int, int, int]:
 
 
 def pack_fwd_req(group: int, payload: bytes,
-                 timeout_s: float = 30.0) -> bytes:
+                 timeout_s: float = 30.0, ftype: int = FWD_REQ) -> bytes:
     """Client-command forward: a follower relays a submission to the leader
     (the transport-level analog of the reference's NotLeader redirect hint,
     support/anomaly/NotLeaderException.java:11-27, resolved inside the
     cluster instead of bounced to the client).  The client's wait budget
-    travels with the request so the serving side honors it."""
+    travels with the request so the serving side honors it.  ``ftype``
+    FWD_READ carries a linearizable read instead (same body layout)."""
     tmo_ms = max(1, min(int(timeout_s * 1000), 0xFFFFFFFF))
-    return frame(FWD_REQ, struct.pack("<II", group, tmo_ms) + payload)
+    return frame(ftype, struct.pack("<II", group, tmo_ms) + payload)
 
 
 def unpack_fwd_req(body: bytes) -> Tuple[int, float, bytes]:

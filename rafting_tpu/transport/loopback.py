@@ -75,7 +75,8 @@ class LoopbackTransport:
                  on_slice: Callable,
                  snapshot_provider: Optional[Callable] = None,
                  submit_handler: Optional[Callable] = None,
-                 result_encoder: Optional[Callable] = None):
+                 result_encoder: Optional[Callable] = None,
+                 read_handler: Optional[Callable] = None):
         self.net = network
         self.node_id = node_id
         self.cfg = cfg
@@ -84,6 +85,7 @@ class LoopbackTransport:
         self.snapshot_provider = snapshot_provider
         self.submit_handler = submit_handler
         self.result_encoder = result_encoder
+        self.read_handler = read_handler
 
     def start(self) -> None:
         self.net.transports[self.node_id] = self
@@ -121,6 +123,19 @@ class LoopbackTransport:
         if t is None:
             return False, b"peer down"
         return codec.serve_forward(t.submit_handler, group, payload, timeout,
+                                   t.result_encoder)
+
+    def forward_read(self, peer: int, group: int, payload: bytes,
+                     timeout: float = 30.0):
+        """Relay a linearizable read to the leader (the loopback analog of
+        TcpTransport.forward_read — serve side routes to RaftNode.read)."""
+        if not (self.net._up(self.node_id, peer)
+                and self.net._up(peer, self.node_id)):
+            return False, b"link down"
+        t = self.net.transports.get(peer)
+        if t is None:
+            return False, b"peer down"
+        return codec.serve_forward(t.read_handler, group, payload, timeout,
                                    t.result_encoder)
 
     def fetch_snapshot(self, peer: int, group: int, index: int, term: int,

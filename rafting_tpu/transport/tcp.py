@@ -117,9 +117,12 @@ class TcpTransport:
                  on_slice: Callable,
                  snapshot_provider: Optional[Callable] = None,
                  submit_handler: Optional[Callable] = None,
-                 result_encoder: Optional[Callable] = None):
+                 result_encoder: Optional[Callable] = None,
+                 read_handler: Optional[Callable] = None):
         """``submit_handler(group, payload) -> Future`` serves forwarded
         client commands (None -> forwards are refused).
+        ``read_handler(group, payload) -> Future`` serves forwarded
+        linearizable reads (RaftNode.read; None -> read forwards refused).
         ``result_encoder(result) -> bytes`` encodes forwarded apply results
         (the node's CmdSerializer, api/serial.py; default JSON)."""
         self.node_id = node_id
@@ -130,6 +133,7 @@ class TcpTransport:
         self.snapshot_provider = snapshot_provider
         self.submit_handler = submit_handler
         self.result_encoder = result_encoder
+        self.read_handler = read_handler
         self._hello = codec.pack_hello(node_id, cfg.n_groups, cfg.n_peers,
                                        cfg.batch)
         self._senders: Dict[int, PeerSender] = {}
@@ -296,6 +300,9 @@ class TcpTransport:
                     elif ftype == codec.FWD_REQ:
                         self._serve_forward(conn, body)
                         return  # ephemeral: one command, then close
+                    elif ftype == codec.FWD_READ:
+                        self._serve_forward(conn, body, read=True)
+                        return  # ephemeral: one read, then close
         except (OSError, IOError, ValueError, struct.error):
             # Malformed frames (struct/ValueError from a buggy or hostile
             # peer) end the connection cleanly, same as transport errors.
@@ -311,25 +318,38 @@ class TcpTransport:
                        ) -> Tuple[bool, bytes]:
         """Relay a client command to ``peer`` and wait for the apply result
         (JSON bytes).  Blocking — call from a worker/client thread."""
+        return self._forward(peer, group, payload, timeout, codec.FWD_REQ)
+
+    def forward_read(self, peer: int, group: int, payload: bytes,
+                     timeout: float = 30.0) -> Tuple[bool, bytes]:
+        """Relay a linearizable read to ``peer`` (the leader) and wait for
+        the query result — the read-plane sibling of forward_submit."""
+        return self._forward(peer, group, payload, timeout, codec.FWD_READ)
+
+    def _forward(self, peer: int, group: int, payload: bytes,
+                 timeout: float, ftype: int) -> Tuple[bool, bytes]:
         try:
             with socket.create_connection(self.peers[peer],
                                           timeout=timeout) as sock:
                 sock.settimeout(timeout + 1.0)  # serve side bounds the wait
-                sock.sendall(codec.pack_fwd_req(group, payload, timeout))
+                sock.sendall(codec.pack_fwd_req(group, payload, timeout,
+                                                ftype))
                 reader = codec.FrameReader()
                 while True:
                     data = sock.recv(1 << 20)
                     if not data:
                         return False, b"connection closed"
-                    for ftype, body in reader.feed(data):
-                        if ftype == codec.FWD_RESP:
+                    for ftype_r, body in reader.feed(data):
+                        if ftype_r == codec.FWD_RESP:
                             return codec.unpack_fwd_resp(body)
         except OSError as e:
             return False, str(e).encode()
 
-    def _serve_forward(self, conn: socket.socket, body: bytes):
+    def _serve_forward(self, conn: socket.socket, body: bytes,
+                       read: bool = False):
         group, timeout_s, payload = codec.unpack_fwd_req(body)
-        ok, res = codec.serve_forward(self.submit_handler, group, payload,
+        handler = self.read_handler if read else self.submit_handler
+        ok, res = codec.serve_forward(handler, group, payload,
                                       timeout_s, self.result_encoder)
         conn.sendall(codec.pack_fwd_resp(ok, res))
 

@@ -12,8 +12,16 @@ from __future__ import annotations
 
 import bisect
 import json
+import re
 import time
 from typing import Dict, List, Optional
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str, prefix: str) -> str:
+    """Sanitize a registry name into a Prometheus metric name."""
+    return prefix + _NAME_RE.sub("_", name)
 
 
 class Histogram:
@@ -119,3 +127,35 @@ class Metrics:
 
     def to_json(self) -> str:
         return json.dumps(self.to_dict())
+
+    def render_prometheus(self, prefix: str = "raft_") -> str:
+        """Prometheus text exposition format 0.0.4 of the whole registry.
+
+        Counters render as ``<prefix><name>_total`` (counter), gauges as
+        ``<prefix><name>`` (gauge), histograms as the standard cumulative
+        ``_bucket{le=...}`` / ``_sum`` / ``_count`` triplet over the fixed
+        log-spaced bounds.  Names are sanitized to the Prometheus charset;
+        dependency-free (no client library) by design, like the rest of
+        this module — serve it from any HTTP handler with content type
+        ``text/plain; version=0.0.4``."""
+        lines: List[str] = []
+        for name in sorted(self._counters):
+            m = _prom_name(name, prefix) + "_total"
+            lines.append(f"# TYPE {m} counter")
+            lines.append(f"{m} {self._counters[name]}")
+        for name in sorted(self._gauges):
+            m = _prom_name(name, prefix)
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f"{m} {self._gauges[name]}")
+        for name in sorted(self._histograms):
+            h = self._histograms[name]
+            m = _prom_name(name, prefix)
+            lines.append(f"# TYPE {m} histogram")
+            cum = 0
+            for bound, c in zip(h.bounds, h.counts):
+                cum += c
+                lines.append(f'{m}_bucket{{le="{bound:.6g}"}} {cum}')
+            lines.append(f'{m}_bucket{{le="+Inf"}} {h.n}')
+            lines.append(f"{m}_sum {h.total}")
+            lines.append(f"{m}_count {h.n}")
+        return "\n".join(lines) + "\n"

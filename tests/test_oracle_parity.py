@@ -21,7 +21,7 @@ import pytest
 
 from rafting_tpu.core.step import node_step
 from rafting_tpu.core.types import (
-    EngineConfig, HostInbox, Messages, init_state,
+    EngineConfig, HostInbox, Messages, crash_restart, init_state,
 )
 from rafting_tpu.testkit.oracle import _np, oracle_step
 
@@ -30,8 +30,8 @@ from rafting_tpu.testkit.oracle import _np, oracle_step
 # values elsewhere.
 MSG_GROUPS = {
     "ae_valid": ["ae_term", "ae_prev_idx", "ae_prev_term", "ae_commit",
-                 "ae_n", "ae_ents"],
-    "aer_valid": ["aer_term", "aer_success", "aer_match"],
+                 "ae_n", "ae_ents", "ae_tick"],
+    "aer_valid": ["aer_term", "aer_success", "aer_match", "aer_tick"],
     "rv_valid": ["rv_term", "rv_last_idx", "rv_last_term", "rv_prevote"],
     "rvr_valid": ["rvr_term", "rvr_granted", "rvr_prevote", "rvr_echo"],
     "is_valid": ["is_term", "is_idx", "is_last_term"],
@@ -88,7 +88,8 @@ def route_numpy(outboxes, conn):
 
 
 def run_parity(seed: int, n_ticks: int, cfg: EngineConfig,
-               drop_p: float = 0.15, part_p: float = 0.1):
+               drop_p: float = 0.15, part_p: float = 0.1,
+               crash_p: float = 0.0, stall_p: float = 0.0):
     N, G = cfg.n_peers, cfg.n_groups
     rng = np.random.default_rng(seed)
     states = [init_state(cfg, i, seed=seed) for i in range(N)]
@@ -115,10 +116,40 @@ def run_parity(seed: int, n_ticks: int, cfg: EngineConfig,
         conn &= rng.random((N, N)) > drop_p
         np.fill_diagonal(conn, True)
 
+        # Crash-restarts and clock stalls (the device nemesis fault model,
+        # host-orchestrated): a crashed node resets volatile state to the
+        # durable frontier BEFORE the tick (types.crash_restart — the
+        # kernel and oracle then both step the restarted state, so parity
+        # covers the post-crash lanes, read FIFO drop included); a stalled
+        # node does not step at all and loses inbound + sends nothing,
+        # drifting its clock from its peers' (the lease's adversary).
+        crashed = rng.random(N) < crash_p
+        stalled = rng.random(N) < stall_p
+        for n in range(N):
+            if crashed[n]:
+                # Leaf-copy: eager crash_restart aliases jnp.zeros constant
+                # buffers across fields, and the donating node_step rejects
+                # a buffer donated twice (inside the fused scan the vmap
+                # body never materializes the aliases, so only this eager
+                # harness needs the copy).
+                states[n] = jax.tree.map(lambda a: a.copy(),
+                                         crash_restart(cfg, states[n]))
+            if crashed[n] or stalled[n]:
+                conn[:, n] = False
+                conn[n, n] = True
+
         inboxes = route_numpy(outboxes, conn)
         new_outboxes = []
         for n in range(N):
+            if stalled[n]:
+                new_outboxes.append(Messages.empty(cfg))
+                continue
             sub = rng.integers(0, cfg.max_submit + 1, size=G).astype(np.int32)
+            # Linearizable read offers ride the same chaos schedule (the
+            # read plane is part of the checked semantics), plus an
+            # occasional host read-veto (process-pause detection).
+            reads = rng.integers(0, 4, size=G).astype(np.int32)
+            veto = bool(rng.random() < 0.05)
             host = HostInbox.empty(cfg)
             if infos[n] is not None:
                 prev = infos[n]
@@ -129,12 +160,15 @@ def run_parity(seed: int, n_ticks: int, cfg: EngineConfig,
                     0).astype(np.int32)
                 host = host.replace(
                     submit_n=sub,
+                    read_n=reads,
+                    read_veto=np.asarray(veto),
                     snap_done=np.asarray(prev.snap_req),
                     snap_idx=np.asarray(prev.snap_req_idx),
                     snap_term=np.asarray(prev.snap_req_term),
                     compact_to=compact)
             else:
-                host = host.replace(submit_n=sub)
+                host = host.replace(submit_n=sub, read_n=reads,
+                                    read_veto=np.asarray(veto))
 
             # Oracle FIRST: node_step donates the state buffers.
             o_state, o_out, o_info = oracle_step(cfg, states[n], inboxes[n],
@@ -168,6 +202,28 @@ def test_parity_no_prevote():
                        max_submit=4, election_ticks=6, heartbeat_ticks=2,
                        rpc_timeout_ticks=5, pre_vote=False)
     run_parity(7, n_ticks=60, cfg=cfg)
+
+
+def test_parity_strict_read_index():
+    """Lease fast path OFF: barrier evidence is the echoed send tick (the
+    textbook dedicated-confirmation-round ReadIndex) — the read plane's
+    other mode must hold kernel<->oracle parity too, under the full
+    partition + crash-restart + clock-stall chaos mix."""
+    cfg = EngineConfig(n_groups=8, n_peers=3, log_slots=16, batch=4,
+                       max_submit=4, election_ticks=6, heartbeat_ticks=2,
+                       rpc_timeout_ticks=5, pre_vote=True, read_lease=False)
+    run_parity(13, n_ticks=60, cfg=cfg, crash_p=0.04, stall_p=0.06)
+
+
+def test_parity_small_read_fifo():
+    """K=1 pending slot: intake backpressure (offers refused while a batch
+    is pending) and same-tick lease release both exercised at the ring's
+    smallest size — with crash-restarts dropping the FIFO and clock
+    stalls drifting the lease evidence clocks (the lease adversary)."""
+    cfg = EngineConfig(n_groups=8, n_peers=3, log_slots=16, batch=4,
+                       max_submit=4, election_ticks=6, heartbeat_ticks=2,
+                       rpc_timeout_ticks=5, pre_vote=True, read_slots=1)
+    run_parity(17, n_ticks=60, cfg=cfg, crash_p=0.04, stall_p=0.06)
 
 
 def test_parity_five_nodes():

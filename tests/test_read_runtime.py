@@ -1,0 +1,264 @@
+"""Read plane, host runtime tier: RaftNode.read end to end over a live
+LocalCluster (real WAL, state machines, codec round-trips), the
+follower->leader read forward, the stub's bounded NotLeader redirect cap,
+the read-veto pause guard, and Prometheus metrics exposition.
+"""
+
+import json
+import os
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from rafting_tpu.api.anomaly import NotLeaderError, is_refusal
+from rafting_tpu.api.serial import JsonSerializer
+from rafting_tpu.api.stub import RaftStub
+from rafting_tpu.core.types import EngineConfig
+from rafting_tpu.machine.kv_machine import KVMachineProvider
+from rafting_tpu.testkit.harness import LocalCluster
+from rafting_tpu.utils.metrics import Metrics
+
+
+def _cfg(**kw) -> EngineConfig:
+    base = dict(n_groups=2, n_peers=3, log_slots=32, batch=4, max_submit=4,
+                election_ticks=6, heartbeat_ticks=2, rpc_timeout_ticks=5,
+                pre_vote=True)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+@pytest.fixture
+def kv_cluster(tmp_path):
+    root = str(tmp_path)
+    lc = LocalCluster(
+        _cfg(), root,
+        provider_factory=lambda i: KVMachineProvider(
+            os.path.join(root, f"kv{i}")))
+    try:
+        yield lc
+    finally:
+        lc.close()
+
+
+def _kv(op, k, v=None) -> bytes:
+    cmd = {"op": op, "k": k}
+    if v is not None:
+        cmd["v"] = v
+    return json.dumps(cmd).encode()
+
+
+def _ready_leader(lc, group=0):
+    leader = lc.wait_leader(group)
+    node = lc.nodes[leader]
+    lc.tick_until(lambda: node.is_ready(group), what="leader ready")
+    return leader, node
+
+
+# --------------------------------------------------------------- end to end --
+
+def test_read_after_write_linearizable(kv_cluster):
+    lc = kv_cluster
+    _, node = _ready_leader(lc)
+    wf = node.submit(0, _kv("set", "a", 42))
+    lc.tick_until(wf.done, what="write applied")
+    assert wf.result() == 42
+    rf = node.read(0, _kv("get", "a"))
+    lc.tick_until(rf.done, what="read served")
+    assert rf.result() == 42
+    # A batch shares one barrier and resolves in order.
+    bf = node.read_batch(0, [_kv("get", "a"), _kv("get", "missing")])
+    lc.tick_until(bf.done, what="read batch served")
+    assert bf.result() == [42, None]
+    assert node.metrics["reads_served"] >= 3
+    # Reads never grew the log: durable tail is untouched by the reads.
+    tail_after = node.store.tail(0)
+    rf2 = node.read(0, _kv("get", "a"))
+    lc.tick_until(rf2.done, what="second read")
+    assert node.store.tail(0) == tail_after
+
+
+def test_follower_read_refused_with_hint(kv_cluster):
+    lc = kv_cluster
+    leader, _ = _ready_leader(lc)
+    follower = lc.nodes[(leader + 1) % 3]
+    fut = follower.read(0, _kv("get", "a"))
+    assert fut.done()
+    exc = fut.exception()
+    assert isinstance(exc, NotLeaderError)
+    assert exc.leader == leader
+    # Reads never enter the log -> ALWAYS a marked retry-safe refusal.
+    assert is_refusal(exc)
+
+
+def test_forward_read_follower_to_leader(kv_cluster):
+    """The FWD_READ channel: a follower relays the read to the leader and
+    returns the query result — reads work from any node."""
+    lc = kv_cluster
+    leader, node = _ready_leader(lc)
+    wf = node.submit(0, _kv("set", "k", "v1"))
+    lc.tick_until(wf.done, what="write applied")
+    follower = lc.nodes[(leader + 1) % 3]
+    box = {}
+
+    def relay():
+        box["res"] = follower.transport.forward_read(
+            leader, 0, _kv("get", "k"), timeout=10.0)
+
+    th = threading.Thread(target=relay, daemon=True)
+    th.start()
+    lc.tick_until(lambda: "res" in box, what="forwarded read",
+                  max_rounds=2000)
+    th.join(timeout=5)
+    ok, raw = box["res"]
+    assert ok, raw
+    assert json.loads(raw) == "v1"
+
+
+def test_read_survives_veto_pause(kv_cluster):
+    """A detected wall-clock pause (HostInbox.read_veto) drops lease
+    evidence — the pending read is NOT served on stale evidence, but the
+    barrier re-earns fresh acks and the read still completes."""
+    lc = kv_cluster
+    _, node = _ready_leader(lc)
+    wf = node.submit(0, _kv("set", "p", 7))
+    lc.tick_until(wf.done, what="write applied")
+    # Simulate a long process pause right before the next tick.
+    node._tick_interval = 0.02
+    node._last_tick_wall = time.monotonic() - 10.0
+    rf = node.read(0, _kv("get", "p"))
+    node.tick()
+    # The veto is HELD for read_fresh_ticks consecutive ticks, not one:
+    # pause-era acks can drain from socket buffers over several ticks,
+    # and a single-tick veto would let lease evidence resurrect from
+    # them (the tick clock did not advance during the wall pause).
+    assert node.metrics["read_vetoes"] >= 1
+    assert node._read_veto_hold == max(node.cfg.read_fresh_ticks, 2) - 1
+    lc.tick_until(rf.done, what="read after pause")
+    assert rf.result() == 7
+    node._tick_interval = None
+
+
+# ------------------------------------------------------- stub redirect cap --
+
+class _StuckFollowerNode:
+    """A node that never leads and never learns a hint — the worst-case
+    election ping-pong from the stub's point of view."""
+
+    node_id = 0
+    serializer = JsonSerializer()
+
+    def __init__(self):
+        class _T:
+            def forward_submit(self, peer, lane, payload, timeout=None):
+                raise AssertionError("no hint -> no forward expected")
+
+            forward_read = forward_submit
+
+        self.transport = _T()
+
+    def is_leader(self, lane):
+        return False
+
+    def leader_hint(self, lane):
+        return None
+
+    def submit(self, lane, payload):
+        raise AssertionError("not leader -> no local submit expected")
+
+    read = submit
+
+
+class _HintPingPongNode(_StuckFollowerNode):
+    """Always hints at peer 1, whose serve side refuses NotLeader back —
+    the two ex-leaders pointing at each other."""
+
+    def __init__(self):
+        super().__init__()
+        self.forwards = 0
+        node = self
+
+        class _T:
+            def forward_submit(self, peer, lane, payload, timeout=None):
+                node.forwards += 1
+                return False, b"REFUSED:NotLeaderError: group 0: not leader"
+
+            forward_read = forward_submit
+
+        self.transport = _T()
+
+    def leader_hint(self, lane):
+        return 1
+
+
+class _FakeContainer:
+    def __init__(self, node):
+        self._node = node
+
+    def _lookup(self, name):
+        return 0
+
+
+@pytest.mark.parametrize("op", ["submit", "read"])
+def test_stub_redirect_cap_no_hint(op):
+    """max_redirects bounds the retry loop: with a huge budget left, a
+    hintless election still fails fast after the capped retries instead
+    of burning the whole budget."""
+    stub = RaftStub(_FakeContainer(_StuckFollowerNode()), "g", 0,
+                    forward=True, forward_budget=300.0, max_redirects=3)
+    t0 = time.monotonic()
+    fut = getattr(stub, op)(b"x")
+    with pytest.raises(NotLeaderError):
+        fut.result(timeout=30)
+    assert time.monotonic() - t0 < 10.0, "redirect cap did not bound the loop"
+
+
+@pytest.mark.parametrize("op", ["submit", "read"])
+def test_stub_redirect_cap_ping_pong(op):
+    """Ex-leaders hinting at each other: the forward channel keeps
+    answering REFUSED:NotLeader — the cap bounds the ping-pong COUNT."""
+    node = _HintPingPongNode()
+    stub = RaftStub(_FakeContainer(node), "g", 0,
+                    forward=True, forward_budget=300.0, max_redirects=4)
+    fut = getattr(stub, op)(b"x")
+    with pytest.raises(NotLeaderError):
+        fut.result(timeout=30)
+    assert node.forwards <= 5, f"{node.forwards} forwards despite cap 4"
+
+
+# -------------------------------------------------------------- prometheus --
+
+def test_render_prometheus_format():
+    m = Metrics()
+    m["reads_served"] += 5
+    m.gauge("groups_led", 3)
+    m.observe("read_barrier_latency_s", 0.004)
+    m.observe("read_barrier_latency_s", 0.2)
+    text = m.render_prometheus()
+    assert "# TYPE raft_reads_served_total counter" in text
+    assert "raft_reads_served_total 5" in text
+    assert "# TYPE raft_groups_led gauge" in text
+    assert "raft_groups_led 3" in text
+    assert "# TYPE raft_read_barrier_latency_s histogram" in text
+    assert 'raft_read_barrier_latency_s_bucket{le="+Inf"} 2' in text
+    assert "raft_read_barrier_latency_s_count 2" in text
+    # Cumulative buckets are monotone.
+    counts = [int(line.rsplit(" ", 1)[1]) for line in text.splitlines()
+              if line.startswith("raft_read_barrier_latency_s_bucket")]
+    assert counts == sorted(counts)
+    assert text.endswith("\n")
+
+
+def test_node_metrics_expose_read_counters(kv_cluster):
+    lc = kv_cluster
+    _, node = _ready_leader(lc)
+    wf = node.submit(0, _kv("set", "m", 1))
+    lc.tick_until(wf.done, what="write applied")
+    rf = node.read(0, _kv("get", "m"))
+    lc.tick_until(rf.done, what="read served")
+    text = node.metrics.render_prometheus()
+    assert "raft_reads_served_total" in text
+    assert "raft_read_barrier_latency_s_count" in text
+    assert "raft_read_lease_hits_total" in text
