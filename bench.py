@@ -98,6 +98,11 @@ def child_run(n_groups: int, measure_ticks: int, warmup_ticks: int,
     # the tuned point from the 32k-group sweep (S=32/B=32/L=256 ~ 2.1x —
     # the reference itself ships up to 50 entries per AppendEntries,
     # Leadership.java REPLICATE_LIMIT).
+    # BENCH_TRACE=1: compile the flight recorder into the scan
+    # (cfg.trace_depth event-ring slots per group, BENCH_TRACE_DEPTH
+    # overrides the default 8) — the recorder-overhead A/B: same load,
+    # same schedule, commits/sec with the trace lanes vs without.
+    trace_on = env_flag("BENCH_TRACE")
     cfg = EngineConfig(
         n_groups=n_groups, n_peers=n_peers,
         log_slots=int(os.environ.get("BENCH_LOG_SLOTS", "64")),
@@ -109,6 +114,8 @@ def child_run(n_groups: int, measure_ticks: int, warmup_ticks: int,
         # (ops/quorum.py) instead of inline jnp — the A/B the TPU decision
         # needs is then one env var per run.
         use_pallas=env_flag("BENCH_USE_PALLAS"),
+        trace_depth=(int(os.environ.get("BENCH_TRACE_DEPTH", "8"))
+                     if trace_on else 0),
     )
     # Group-axis tiling (groups are independent; run_cluster_ticks_blocked).
     # The r1 ">= 65k fault" turned out to be the per-execution duration
@@ -271,7 +278,13 @@ def child_run(n_groups: int, measure_ticks: int, warmup_ticks: int,
         "warmup_s": round(warm_s, 2),
         "init_s": round(init_s, 2),
         "nemesis": nemesis_on,
+        "trace_depth": cfg.trace_depth,
     }
+    if trace_on:
+        # The recorder must have actually recorded (elections at minimum).
+        ev = int(np.asarray(states.trace.n).astype(np.int64).sum())
+        assert ev > 0, "BENCH_TRACE run recorded zero events"
+        res["trace_events"] = ev
     if reads_on:
         assert read_totals["served"] > 0, "read stage served nothing"
         res.update(
@@ -292,6 +305,8 @@ def headline(res: dict, fallback: str = "", tuned: bool = False,
     note += TUNED_TAG if tuned else ""
     if res.get("nemesis"):
         note += " [NEMESIS: three-regime fault schedule on]"
+    if res.get("trace_depth"):
+        note += f" [TRACE: flight recorder on, depth {res['trace_depth']}]"
     note += f" [{extra_note}]" if extra_note else ""
     return {
         # "device engine, payload-free": the full consensus protocol
@@ -479,6 +494,12 @@ def main() -> None:
 
     best = None
     best_is_tuned = False
+    # The extra env AND run shape (ticks, warmup) that produced `best` —
+    # any later stage whose number is COMPARED against best (the
+    # flight-recorder A/B) must re-run identically, or the ratio
+    # conflates config / run-length effects with stage overhead.
+    best_env: dict = {}
+    best_shape = (512, 128)
     if not device_ok:
         scales = []   # straight to the CPU fallback below
         run_scale.last_failure = probe_why
@@ -499,6 +520,7 @@ def main() -> None:
             # timeout): larger scales may still succeed.
             continue
         best = res
+        best_shape = (ticks, warmup)
         sys.stderr.write(f"[bench] scale {g}: {res['cps']:,.0f} commits/s "
                          f"({res['platform']}, warmup {res['warmup_s']}s)\n")
         emit(headline(best))
@@ -524,6 +546,8 @@ def main() -> None:
         if res is not None:
             best = res
             best_is_tuned = bool(tuned)
+            best_env = dict(tuned)
+            best_shape = (96, 48)
             emit(headline(best, fallback=why, tuned=bool(tuned)))
 
     if best is None:
@@ -545,7 +569,7 @@ def main() -> None:
     #    reasonable deadline (r4 rehearsal: timed out at 256 ticks/420 s
     #    while the Pallas stage had already improved the headline).
     def bonus(extra_env, tag, ticks, warmup, timeout_s):
-        nonlocal best
+        nonlocal best, best_env
         remaining = budget - (time.monotonic() - t_start)
         if remaining < timeout_s * 0.4:
             return
@@ -557,6 +581,8 @@ def main() -> None:
             emit(headline(res, tuned=(extra_env is TUNED_ENV),
                           extra_note="" if extra_env is TUNED_ENV else tag))
             best = res
+            best_env = dict(extra_env)
+            best_shape = (ticks, warmup)
 
     if (scales and best["scale"] == scales[-1] and only is None
             and not best_is_tuned):
@@ -618,6 +644,46 @@ def main() -> None:
                 sys.stderr.write(f"[bench] nemesis faults-on: "
                                  f"{res['cps']:,.0f} commits/s\n")
                 emit(headline(res))
+
+    # Flight-recorder overhead stage (BENCH_TRACE=1 in the child): the
+    # same ladder load with cfg.trace_depth event rings compiled into the
+    # scan, compared against the banked traceless number — the "tracing
+    # is cheap enough to leave on" evidence (acceptance: <= 5% commits/sec
+    # regression).  vs_baseline here is with-trace / without-trace, so
+    # 0.95+ passes.  Skipped when the operator pinned any stage flag (a
+    # pinned ladder already measured what they asked for).
+    if (best is not None and "BENCH_TRACE" not in os.environ
+            and "BENCH_READS" not in os.environ
+            and "BENCH_NEMESIS" not in os.environ):
+        remaining = budget - (time.monotonic() - t_start)
+        tr_timeout = float(os.environ.get("BENCH_TRACE_TIMEOUT", "300"))
+        if remaining >= tr_timeout * 0.4:
+            ticks, warmup = best_shape
+            res = run_scale(best["scale"], ticks, warmup,
+                            min(tr_timeout, remaining),
+                            platform="cpu" if best["platform"] == "cpu"
+                            else "",
+                            # Same config AND run shape that produced
+                            # `best`, plus the recorder — the ratio
+                            # isolates trace cost.
+                            extra_env={**best_env, "BENCH_TRACE": "1"})
+            if res is not None:
+                ratio = res["cps"] / best["cps"]
+                sys.stderr.write(
+                    f"[bench] flight recorder on: {res['cps']:,.0f} "
+                    f"commits/s ({(1 - ratio) * 100:+.1f}% overhead, "
+                    f"{res.get('trace_events', 0)} events)\n")
+                emit({
+                    "metric": f"flight-recorder overhead "
+                              f"@{res['scale'] // 1000}k Raft groups: "
+                              f"commits/sec with trace_depth="
+                              f"{res['trace_depth']} vs "
+                              f"{round(best['cps'])} without "
+                              f"({res['platform']})",
+                    "value": round(res["cps"]),
+                    "unit": "commits/sec",
+                    "vs_baseline": round(ratio, 3),
+                })
 
 
 if __name__ == "__main__":

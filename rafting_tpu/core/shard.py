@@ -23,6 +23,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
 
 from .types import (
     EngineConfig, FaultSchedule, LogState, Messages, RaftState, StepInfo,
+    TraceState,
 )
 
 # RaftState fields with no group axis: per-node scalars and the PRNG key.
@@ -33,13 +34,21 @@ _NODE_GROUP = PS("node", "group")          # [N, G, ...] — trailing dims repli
 _NODE_PEER_GROUP = PS("node", None, "group")  # [N, P, G, ...] message planes
 
 
-def state_pspecs() -> RaftState:
-    """A RaftState-shaped pytree of PartitionSpecs for stacked [N, ...] state."""
+def state_pspecs(trace: bool = False) -> RaftState:
+    """A RaftState-shaped pytree of PartitionSpecs for stacked [N, ...] state.
+
+    ``trace`` must match whether the state carries flight-recorder lanes
+    (cfg.trace_depth > 0): a None subtree in the state needs a None in the
+    spec tree, and recorder lanes are [N, G, D] group-major like every
+    per-group lane."""
     kw = {f.name: _NODE_GROUP for f in dataclasses.fields(RaftState)}
     for name in _STATE_NODE_ONLY:
         kw[name] = _NODE
     kw["log"] = LogState(term=_NODE_GROUP, base=_NODE_GROUP,
                          base_term=_NODE_GROUP, last=_NODE_GROUP)
+    kw["trace"] = TraceState(
+        tick=_NODE_GROUP, kind=_NODE_GROUP, term=_NODE_GROUP,
+        aux=_NODE_GROUP, n=_NODE_GROUP) if trace else None
     return RaftState(**kw)
 
 
@@ -95,6 +104,9 @@ def validate_cluster_shapes(cfg: EngineConfig, states: RaftState,
     assert states.term.ndim == 2 and states.term.shape[1] == G, states.term.shape
     assert states.next_idx.shape[1:] == (G, P), states.next_idx.shape
     assert states.log.term.shape[1] == G, states.log.term.shape
+    if states.trace is not None:
+        assert states.trace.tick.shape[1] == G, states.trace.tick.shape
+        assert states.trace.n.shape[1:] == (G,), states.trace.n.shape
     assert inflight.ae_valid.ndim == 3 and inflight.ae_valid.shape[2] == G, \
         inflight.ae_valid.shape
     assert info.commit.shape[1] == G, info.commit.shape
@@ -118,7 +130,7 @@ def shard_cluster(mesh: Mesh, cfg: EngineConfig, states: RaftState,
             lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
             tree, specs)
 
-    states = put(states, state_pspecs())
+    states = put(states, state_pspecs(trace=states.trace is not None))
     inflight = put(inflight, messages_pspecs())
     info = put(info, info_pspecs())
     conn = jax.device_put(conn, NamedSharding(mesh, CONN_PSPEC))

@@ -207,8 +207,9 @@ def run_cluster_ticks_blocked(cfg: EngineConfig, n_ticks: int,
     gb = group_block
     cfg_blk = dataclasses.replace(cfg, n_groups=gb)
 
-    st_specs, msg_specs, inf_specs = (state_pspecs(), messages_pspecs(),
-                                      info_pspecs())
+    st_specs, msg_specs, inf_specs = (
+        state_pspecs(trace=states.trace is not None), messages_pspecs(),
+        info_pspecs())
     states_b = _to_blocks(states, st_specs, nb, gb)
     inflight_b = _to_blocks(inflight, msg_specs, nb, gb)
     info_b = _to_blocks(prev_info, inf_specs, nb, gb)

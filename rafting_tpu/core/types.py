@@ -116,6 +116,14 @@ class EngineConfig:
                                   #     evidence (bounds duplicate-delivery
                                   #     chains to one hop — see step.py
                                   #     read-barrier phase for the proof)
+    trace_depth: int = 0          # D — flight-recorder ring depth per group
+                                  #     (TraceState lanes; events written
+                                  #     branchlessly at the step's phase
+                                  #     boundaries).  0 disables the
+                                  #     recorder entirely: the trace subtree
+                                  #     is None, so the state pytree and the
+                                  #     compiled step are bit-identical to a
+                                  #     build without the feature.
 
     def __post_init__(self):
         assert self.n_peers >= 1
@@ -128,6 +136,9 @@ class EngineConfig:
         assert self.read_slots >= 1, "read plane needs >= 1 pending slot"
         assert self.read_fresh_ticks >= 2, \
             "lease evidence needs the 2-tick delivery round trip"
+        assert self.trace_depth == 0 or self.trace_depth >= 8, \
+            "flight-recorder rings need >= 8 slots (one tick can emit " \
+            "up to 8 events, batched into one scatter per lane)"
 
     @property
     def majority(self) -> int:
@@ -148,6 +159,86 @@ class LogState:
     base: jax.Array       # [G] int32 — compaction floor ("epoch"); entries (base, last] live
     base_term: jax.Array  # [G] int32 — term of the entry at `base` (snapshot milestone term)
     last: jax.Array       # [G] int32 — last appended index (0 = empty)
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder: a fixed-depth per-group ring of event records written
+# branchlessly at the phase boundaries of core/step.py (the device-side
+# answer to "which replica did what when" — the debugging currency
+# "Paxos vs Raft" (arxiv 2004.05074) identifies as the real-world pain).
+# The event-kind taxonomy is OWNED by utils/tracelog.py (numpy+stdlib
+# only, so post-mortem dump decoding needs no engine import) and
+# re-exported here for the kernel and oracle.  Canonical INTRA-TICK
+# emission order is the numeric kind order, except TR_CRASH_RESTART,
+# which crash_restart writes BEFORE the tick's step runs (its tick stamp
+# is the pre-step clock).  Per-kind aux payloads:
+#   TR_TERM_BUMP            aux = previous term
+#   TR_STEPPED_DOWN         aux = new leader hint (NIL if unknown)
+#   TR_BECAME_PRE_CANDIDATE aux = 0
+#   TR_BECAME_CANDIDATE     aux = 0 prevote majority / 1 timer expiry
+#                           ("elections by cause" decodes from this)
+#   TR_BECAME_LEADER        aux = §8 no-op index (0: ring full, none)
+#   TR_SNAPSHOT_INSTALL     aux = installed milestone index
+#   TR_COMMIT_ADVANCE       aux = new commit index
+#   TR_READ_RELEASE         aux = individual reads released
+#   TR_CRASH_RESTART        aux = durable log tail survived into boot
+# The scalar oracle (testkit/oracle.py) emits the identical stream, so
+# the recorder itself is parity-checked; utils/tracelog.py decodes.
+# ---------------------------------------------------------------------------
+from ..utils.tracelog import (  # noqa: F401  (re-exported taxonomy)
+    TR_BECAME_CANDIDATE, TR_BECAME_LEADER, TR_BECAME_PRE_CANDIDATE,
+    TR_COMMIT_ADVANCE, TR_CRASH_RESTART, TR_READ_RELEASE,
+    TR_SNAPSHOT_INSTALL, TR_STEPPED_DOWN, TR_TERM_BUMP, TRACE_EVENTS,
+)
+
+
+@struct.dataclass
+class TraceState:
+    """Per-group flight-recorder rings (cfg.trace_depth slots per group).
+
+    One logical event word is the (tick, kind, term, aux) quadruple at one
+    ring slot; ``n`` counts events ever written, so slot ``i % D`` holds
+    event ``i`` and a host that drained through event ``m`` detects loss
+    exactly when ``n - m > D`` (the ring overwrote the gap).  All lanes
+    are I32 like every engine lane; the recorder is observability state,
+    NOT protocol state — no step phase ever reads it back.
+    """
+
+    tick: jax.Array   # [G, D] int32 — event tick stamp (node's own clock)
+    kind: jax.Array   # [G, D] int32 — TR_* event kind
+    term: jax.Array   # [G, D] int32 — group term at emission
+    aux: jax.Array    # [G, D] int32 — per-kind payload (see TR_* comments)
+    n: jax.Array      # [G] int32 — events ever written (ring head = n % D)
+
+    @classmethod
+    def empty(cls, n_groups: int, depth: int) -> "TraceState":
+        z = lambda *sh: jnp.zeros(sh, I32)
+        return cls(tick=z(n_groups, depth), kind=z(n_groups, depth),
+                   term=z(n_groups, depth), aux=z(n_groups, depth),
+                   n=z(n_groups))
+
+
+def trace_append(tr: TraceState, mask: jax.Array, kind: int,
+                 tick, term, aux) -> TraceState:
+    """Branchless masked append of one event kind across all groups.
+
+    Lanes where ``mask`` is False write nowhere (their slot compares
+    equal to no ring position) and keep their count.  Compare-and-select,
+    not scatter: scatters inside vmapped scan bodies lower an order of
+    magnitude slower on CPU (see the fused emission block in
+    core/step.py, which batches a whole tick's events the same way)."""
+    G, D = tr.tick.shape
+    slot = jnp.where(mask, jnp.remainder(tr.n, D), D)
+    hit = slot[:, None] == jnp.arange(D, dtype=I32)[None, :]   # [G, D]
+    bc = lambda v: jnp.broadcast_to(jnp.asarray(v, I32), (G,))[:, None]
+    put = lambda ring, v: jnp.where(hit, bc(v), ring)
+    return tr.replace(
+        tick=put(tr.tick, tick),
+        kind=put(tr.kind, kind),
+        term=put(tr.term, term),
+        aux=put(tr.aux, aux),
+        n=tr.n + mask.astype(I32),
+    )
 
 
 @struct.dataclass
@@ -235,6 +326,12 @@ class RaftState:
     rq_head: jax.Array        # [G] int32 — FIFO ring head slot
     rq_len: jax.Array         # [G] int32 — pending batch count (<= K)
 
+    # Flight recorder (cfg.trace_depth > 0).  None when disabled: a None
+    # subtree has NO leaves, so the state pytree — and therefore every
+    # compiled step/scan program — is bit-identical to a traceless build
+    # (the zero-cost-when-off contract, tested in test_tracelog).
+    trace: Any = None         # Optional[TraceState]
+
 
 @struct.dataclass
 class FaultSchedule:
@@ -311,7 +408,15 @@ def crash_restart(cfg: EngineConfig, s: "RaftState") -> "RaftState":
     z = lambda *sh: jnp.zeros(sh, I32)
     f = lambda *sh: jnp.zeros(sh, jnp.bool_)
     boot_next = jnp.broadcast_to(s.log.last[:, None] + 1, (G, P))
+    # The flight recorder survives a crash (it is observability, not
+    # protocol state) and records the restart itself, stamped with the
+    # pre-step clock — the step that follows emits at now + 1.
+    trace = s.trace
+    if trace is not None:
+        trace = trace_append(trace, s.active, TR_CRASH_RESTART,
+                             s.now, s.term, s.log.last)
     return s.replace(
+        trace=trace,
         rng=rng,
         role=z(G),
         leader_id=jnp.full((G,), NIL, I32),
@@ -604,4 +709,6 @@ def init_state(cfg: EngineConfig, node_id: int, seed: int = 0,
         read_evid=z(G, P),
         rq_idx=z(G, K), rq_stamp=z(G, K), rq_n=z(G, K),
         rq_head=z(G), rq_len=z(G),
+        trace=(TraceState.empty(G, cfg.trace_depth)
+               if cfg.trace_depth else None),
     )

@@ -6,5 +6,6 @@ persist-before-send durability barrier each tick."""
 
 from ..api.anomaly import NotLeaderError
 from .node import RaftNode
+from .obsrv import ObservabilityServer
 
-__all__ = ["RaftNode", "NotLeaderError"]
+__all__ = ["RaftNode", "NotLeaderError", "ObservabilityServer"]

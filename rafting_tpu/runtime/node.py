@@ -61,6 +61,7 @@ from ..api.anomaly import (
 )
 from ..utils.metrics import Metrics
 from ..utils.profiling import TickProfiler
+from ..utils.tracelog import TraceLog
 
 log = logging.getLogger(__name__)
 
@@ -390,6 +391,12 @@ class RaftNode:
         # Counter/gauge/histogram registry (SURVEY §5: the build must add
         # commits/sec, election counts, per-step latency histograms).
         self.metrics = Metrics()
+        # Flight-recorder drain (cfg.trace_depth > 0): per-group decoded
+        # timelines + labeled metrics (elections by cause, leader churn)
+        # harvested from the device event rings each tick.  Inert when
+        # tracing is off.  Served over HTTP by start_observability().
+        self.tracelog = TraceLog(cfg)
+        self._obsrv = None
         # Device-profiler hook (SURVEY §5): bounded capture of the tick
         # loop; armed via profile_ticks() or RAFT_PROFILE_DIR.
         self.profiler = TickProfiler.from_env()
@@ -409,10 +416,24 @@ class RaftNode:
             name=f"raft-node-{self.node_id}", daemon=True)
         self._thread.start()
 
+    def start_observability(self, host: str = "127.0.0.1",
+                            port: int = 0):
+        """Attach and start the HTTP observability plane (/metrics,
+        /healthz, /timeline — runtime/obsrv.py).  Returns the server;
+        read ``.port`` for the bound port.  Closed with the node."""
+        from .obsrv import ObservabilityServer
+
+        if self._obsrv is None:
+            self._obsrv = ObservabilityServer(self, host, port).start()
+        return self._obsrv
+
     def close(self) -> None:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
+        if self._obsrv is not None:
+            self._obsrv.close()
+            self._obsrv = None
         self.transport.close()
         # In-flight snapshot workers touch the store; they must finish (or
         # observe _stop) before the native WAL handle is released.
@@ -875,6 +896,24 @@ class RaftNode:
         self._maintain(after, h_base, h_term)
         self._snapshot_requests(h_info, h_base)
 
+        # -- 8. flight-recorder drain ----------------------------------------
+        # Opt-in with the recorder itself: decoded events feed per-group
+        # timelines (HTTP /timeline) and the labeled metrics aggregate
+        # counters cannot express (elections by cause, leader churn).
+        # The cheap [G] event-count lane is pulled first; the full rings
+        # (and the per-moved-group decode) transfer only on ticks where
+        # something actually recorded — a quiet node pays one [G] pull.
+        # NOTE this host drain cost is NOT part of the BENCH_TRACE A/B
+        # (that measures the fused scan); it scales with groups-moved per
+        # tick, like every other host-side per-group path here.
+        if cfg.trace_depth:
+            h_trn = jax.device_get(self.state.trace.n)
+            if self.tracelog.moved(h_trn):
+                for k, v in self.tracelog.ingest(
+                        jax.device_get(self.state.trace)).items():
+                    if v:
+                        self.metrics[k] += v
+
         self.ticks += 1
         self.metrics.observe("tick_latency_s",
                              time.perf_counter() - _tick_t0)
@@ -1273,7 +1312,17 @@ class RaftNode:
             rq_n=s.rq_n.at[idx].set(0),
             rq_head=s.rq_head.at[idx].set(0),
             rq_len=s.rq_len.at[idx].set(0),
+            trace=(s.trace.replace(
+                tick=s.trace.tick.at[idx].set(0),
+                kind=s.trace.kind.at[idx].set(0),
+                term=s.trace.term.at[idx].set(0),
+                aux=s.trace.aux.at[idx].set(0),
+                n=s.trace.n.at[idx].set(0))
+                if s.trace is not None else None),
         )
+        if s.trace is not None:
+            for g in lanes:
+                self.tracelog.reset_group(int(g))
         # device_get arrays may be read-only views; replace, don't mutate
         hc = np.array(self.h_commit)
         hb = np.array(self.h_base)

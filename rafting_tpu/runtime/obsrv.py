@@ -1,0 +1,142 @@
+"""In-process HTTP observability plane for a RaftNode (stdlib only).
+
+The reference ships zero observability beyond logback debug lines
+(SURVEY §5); this server exposes the TPU build's three surfaces over
+plain HTTP so a node under test or in production can be inspected with
+curl and scraped by Prometheus, with no new dependencies:
+
+* ``GET /metrics``            — the whole Metrics registry in text
+  exposition format 0.0.4 (``utils/metrics.render_prometheus``, guarded
+  against non-finite values and validated by the strict parser in
+  ``utils/metrics.validate_exposition``);
+* ``GET /healthz``            — peer-health gate state as JSON: how many
+  groups this node leads and how many of those pass the readiness gate
+  (reference Leader.isReady, Leader.java:52-64), plus tick/uptime vitals;
+* ``GET /timeline?group=N``   — the flight recorder's decoded per-group
+  event timeline (``utils/tracelog.TraceLog``), the "which replica did
+  what when" view; empty unless ``cfg.trace_depth > 0``.
+
+Handlers only READ tick-refreshed host mirrors (``h_role``/``h_ready``/
+``metrics``/``tracelog``) — the same bounded one-tick staleness contract
+as ``RaftNode.submit`` — so serving never blocks or mutates the tick
+thread's state.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from ..core.types import LEADER
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class ObservabilityServer:
+    """Serve /metrics, /healthz and /timeline for one RaftNode.
+
+    ``port=0`` binds an ephemeral port (read :attr:`port` after
+    :meth:`start`).  The server runs daemon threads and is closed by
+    :meth:`close` (RaftNode.close closes an attached server)."""
+
+    def __init__(self, node, host: str = "127.0.0.1", port: int = 0):
+        self.node = node
+        self._t0 = time.monotonic()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet by default
+                pass
+
+            def _reply(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _json(self, code: int, doc: dict) -> None:
+                self._reply(code, json.dumps(doc).encode(),
+                            "application/json")
+
+            def do_GET(self):
+                try:
+                    url = urlparse(self.path)
+                    if url.path == "/metrics":
+                        body = outer.node.metrics.render_prometheus()
+                        self._reply(200, body.encode(), PROM_CONTENT_TYPE)
+                    elif url.path == "/healthz":
+                        self._json(200, outer.healthz())
+                    elif url.path == "/timeline":
+                        q = parse_qs(url.query)
+                        try:
+                            g = int(q.get("group", ["0"])[0])
+                        except ValueError:
+                            g = -1
+                        if not 0 <= g < outer.node.cfg.n_groups:
+                            self._json(400, {"error": "bad group"})
+                            return
+                        self._json(200, outer.timeline(g))
+                    else:
+                        self._json(404, {"error": "unknown path",
+                                         "paths": ["/metrics", "/healthz",
+                                                   "/timeline?group=N"]})
+                except BrokenPipeError:
+                    pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"raft-obsrv-{node.node_id}", daemon=True)
+
+    # ------------------------------------------------------------- views --
+
+    def healthz(self) -> dict:
+        """Peer-health gate state: the vital signs a load balancer or
+        operator needs before routing to this node."""
+        n = self.node
+        led = int((n.h_role == LEADER).sum())
+        ready = int(np.asarray(n.h_ready).sum())
+        return {
+            "ok": True,
+            "node_id": int(n.node_id),
+            "ticks": int(n.ticks),
+            "groups_active": int(n.h_active.sum()),
+            "groups_led": led,
+            "groups_ready": ready,
+            "trace_depth": int(n.cfg.trace_depth),
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+        }
+
+    def timeline(self, g: int) -> dict:
+        n = self.node
+        return {
+            "group": g,
+            "trace_depth": int(n.cfg.trace_depth),
+            "events": n.tracelog.timeline(g),
+            "dropped_total": int(n.tracelog.dropped_total),
+        }
+
+    # --------------------------------------------------------- lifecycle --
+
+    def start(self) -> "ObservabilityServer":
+        self._thread.start()
+        return self
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def close(self) -> None:
+        # shutdown() blocks on an event only serve_forever() sets — never
+        # call it unless start() actually ran the serve thread.
+        if self._thread.is_alive():
+            self._httpd.shutdown()
+            self._thread.join(timeout=5)
+        self._httpd.server_close()

@@ -27,15 +27,23 @@ import numpy as np
 
 from ..core.types import (
     CANDIDATE, FOLLOWER, LEADER, NIL, PRE_CANDIDATE,
+    TR_BECAME_CANDIDATE, TR_BECAME_LEADER, TR_BECAME_PRE_CANDIDATE,
+    TR_COMMIT_ADVANCE, TR_READ_RELEASE, TR_SNAPSHOT_INSTALL,
+    TR_STEPPED_DOWN, TR_TERM_BUMP,
     EngineConfig, HostInbox, Messages, RaftState,
 )
 
 
 def _np(tree) -> Dict[str, np.ndarray]:
-    """Flatten a flax struct dataclass into {field: numpy array}."""
+    """Flatten a flax struct dataclass into {field: numpy array}.
+
+    None subfields (e.g. ``trace`` with the flight recorder disabled) are
+    empty subtrees — skipped, exactly as jax's flatten drops them."""
     out = {}
     for name in tree.__dataclass_fields__:
         v = getattr(tree, name)
+        if v is None:
+            continue
         if hasattr(v, "__dataclass_fields__"):
             for sub, arr in _np(v).items():
                 out[f"{name}.{sub}"] = arr
@@ -118,9 +126,22 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     rq_len = s["rq_len"].copy()
     K = cfg.read_slots
 
+    # Flight recorder (cfg.trace_depth): the scalar mirror of the kernel's
+    # ring writes — same canonical event order, same ring semantics.
+    has_trace = state.trace is not None
+    if has_trace:
+        tr_tick = s["trace.tick"].copy()
+        tr_kind = s["trace.kind"].copy()
+        tr_term = s["trace.term"].copy()
+        tr_aux = s["trace.aux"].copy()
+        tr_n = s["trace.n"].copy()
+        D = tr_tick.shape[1]
+
     old_term = term.copy()
     old_voted = voted.copy()
     old_last = last.copy()
+    old_commit = commit.copy()
+    old_role = role.copy()
 
     # Outbox accumulators, [P, G] dense like the kernel's.
     def zi(*shape):
@@ -247,7 +268,8 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
             votes[g] = False
             votes[g, me] = True
             elect_dl[g] = now + rand_to[g]
-        if role[g] == CANDIDATE and votes[g].sum() >= maj:
+        vote_win = role[g] == CANDIDATE and votes[g].sum() >= maj
+        if vote_win:
             role[g] = LEADER
             leader_id[g] = me
             next_idx[g] = log.last + 1
@@ -362,8 +384,9 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
                                             and covered)
                 out["isr_probe"][p, g] = bool(ib["is_probe"][p, g])
 
-        if (h["snap_done"][g] and active[g]
-                and int(h["snap_idx"][g]) > log.base):
+        snap_inst = (h["snap_done"][g] and active[g]
+                     and int(h["snap_idx"][g]) > log.base)
+        if snap_inst:
             si, st = int(h["snap_idx"][g]), int(h["snap_term"][g])
             tail_matches = si <= log.last and log.term_at(si) == st
             log.base, log.base_term = si, st
@@ -651,6 +674,32 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
             commit[g] = full_idx
         match_idx[g] = full
 
+        # ---- 11. flight recorder ------------------------------------------
+        # (kernel trailing block: same masks, same canonical order, same
+        # ring-overwrite semantics.  All records carry the end-of-tick
+        # term; TR_CRASH_RESTART is emitted by types.crash_restart.)
+        if has_trace and active[g]:
+            def tr_emit(mask, kind, aux):
+                if not mask:
+                    return
+                slot = int(tr_n[g]) % D
+                tr_tick[g, slot] = now
+                tr_kind[g, slot] = kind
+                tr_term[g, slot] = term[g]
+                tr_aux[g, slot] = aux
+                tr_n[g] += 1
+
+            tr_emit(term[g] != old_term[g], TR_TERM_BUMP, old_term[g])
+            tr_emit(old_role[g] == LEADER and role[g] != LEADER,
+                    TR_STEPPED_DOWN, leader_id[g])
+            tr_emit(start_pre, TR_BECAME_PRE_CANDIDATE, 0)
+            tr_emit(became_cand, TR_BECAME_CANDIDATE,
+                    1 if timer_cand else 0)
+            tr_emit(vote_win, TR_BECAME_LEADER, info["noop_idx"][g])
+            tr_emit(snap_inst, TR_SNAPSHOT_INSTALL, h["snap_idx"][g])
+            tr_emit(commit[g] > old_commit[g], TR_COMMIT_ADVANCE, commit[g])
+            tr_emit(n_rel > 0, TR_READ_RELEASE, n_served)
+
         ring[g] = log.ring
         base[g], base_term[g], last[g] = log.base, log.base_term, log.last
         info["dirty"][g] = (term[g] != old_term[g] or voted[g] != old_voted[g]
@@ -686,4 +735,9 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         "rq_idx": rq_idx, "rq_stamp": rq_stamp, "rq_n": rq_n,
         "rq_head": rq_head, "rq_len": rq_len,
     }
+    if has_trace:
+        new_state.update({
+            "trace.tick": tr_tick, "trace.kind": tr_kind,
+            "trace.term": tr_term, "trace.aux": tr_aux, "trace.n": tr_n,
+        })
     return new_state, out, info

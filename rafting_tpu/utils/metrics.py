@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import bisect
 import json
+import math
 import re
 import time
 from typing import Dict, List, Optional
@@ -22,6 +23,29 @@ _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 def _prom_name(name: str, prefix: str) -> str:
     """Sanitize a registry name into a Prometheus metric name."""
     return prefix + _NAME_RE.sub("_", name)
+
+
+def _prom_value(v) -> str:
+    """Render a sample value in exposition-format syntax.
+
+    Python would print ``nan``/``inf``/``-inf``, which the format does not
+    accept — the canonical spellings are ``NaN``/``+Inf``/``-Inf``.  A
+    non-finite gauge (e.g. a rate over a zero interval) must not corrupt
+    the whole scrape page."""
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    return str(v)
+
+
+def escape_label_value(v: str) -> str:
+    """Escape a label VALUE for ``name{label="<here>"}`` (backslash, quote
+    and newline, per the exposition format's label escaping rules) — for
+    handlers that render labeled series on top of this registry."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
 
 
 class Histogram:
@@ -84,6 +108,8 @@ class Metrics:
         self._gauges: Dict[str, float] = {}
         self._histograms: Dict[str, Histogram] = {}
         self._t0 = time.monotonic()
+        self._ckpt_counters: Dict[str, float] = {}
+        self._ckpt_t = self._t0
 
     # counters ---------------------------------------------------------------
     def inc(self, name: str, delta: float = 1) -> None:
@@ -110,10 +136,29 @@ class Metrics:
         self.histogram(name).observe(v)
 
     # reporting --------------------------------------------------------------
-    def rates(self) -> Dict[str, float]:
-        """Counters divided by registry lifetime (e.g. commits/sec)."""
+    def checkpoint(self) -> None:
+        """Snapshot the counters as the baseline for windowed rates: a
+        long-lived node's ``rates(since_last=True)`` then reports CURRENT
+        throughput over the window since this call, not a lifetime
+        average diluted by hours of history (the benchmark checkpoints at
+        the start of its measure phase)."""
+        self._ckpt_counters = dict(self._counters)
+        self._ckpt_t = time.monotonic()
+
+    def rates(self, since_last: bool = False) -> Dict[str, float]:
+        """Counters per second — over the registry lifetime, or (with
+        ``since_last``) over the window since the last :meth:`checkpoint`
+        (boot, if never checkpointed).  Iterates a dict snapshot: readers
+        (HTTP scrape threads) race the tick thread's first-seen counter
+        inserts, and dict(d) is one atomic C call under the GIL."""
+        counters = dict(self._counters)
+        if since_last:
+            dt = max(time.monotonic() - self._ckpt_t, 1e-9)
+            base = self._ckpt_counters
+            return {f"{k}_per_sec": (v - base.get(k, 0)) / dt
+                    for k, v in counters.items()}
         dt = max(time.monotonic() - self._t0, 1e-9)
-        return {f"{k}_per_sec": v / dt for k, v in self._counters.items()}
+        return {f"{k}_per_sec": v / dt for k, v in counters.items()}
 
     def to_dict(self) -> dict:
         return {
@@ -122,7 +167,7 @@ class Metrics:
             "gauges": dict(self._gauges),
             "rates": self.rates(),
             "histograms": {k: h.summary()
-                           for k, h in self._histograms.items()},
+                           for k, h in dict(self._histograms).items()},
         }
 
     def to_json(self) -> str:
@@ -139,16 +184,22 @@ class Metrics:
         this module — serve it from any HTTP handler with content type
         ``text/plain; version=0.0.4``."""
         lines: List[str] = []
-        for name in sorted(self._counters):
+        # Dict snapshots: the renderer runs on HTTP scrape threads while
+        # the tick thread inserts first-seen keys (atomic C-level copies
+        # under the GIL — see rates()).
+        counters = dict(self._counters)
+        gauges = dict(self._gauges)
+        histograms = dict(self._histograms)
+        for name in sorted(counters):
             m = _prom_name(name, prefix) + "_total"
             lines.append(f"# TYPE {m} counter")
-            lines.append(f"{m} {self._counters[name]}")
-        for name in sorted(self._gauges):
+            lines.append(f"{m} {_prom_value(counters[name])}")
+        for name in sorted(gauges):
             m = _prom_name(name, prefix)
             lines.append(f"# TYPE {m} gauge")
-            lines.append(f"{m} {self._gauges[name]}")
-        for name in sorted(self._histograms):
-            h = self._histograms[name]
+            lines.append(f"{m} {_prom_value(gauges[name])}")
+        for name in sorted(histograms):
+            h = histograms[name]
             m = _prom_name(name, prefix)
             lines.append(f"# TYPE {m} histogram")
             cum = 0
@@ -156,6 +207,61 @@ class Metrics:
                 cum += c
                 lines.append(f'{m}_bucket{{le="{bound:.6g}"}} {cum}')
             lines.append(f'{m}_bucket{{le="+Inf"}} {h.n}')
-            lines.append(f"{m}_sum {h.total}")
+            lines.append(f"{m}_sum {_prom_value(h.total)}")
             lines.append(f"{m}_count {h.n}")
         return "\n".join(lines) + "\n"
+
+
+# ------------------------------------------------------------- validation --
+
+_METRIC_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_VALUE = r"(?:[+-]?[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?|\+Inf|-Inf|NaN)"
+_TYPE_LINE = re.compile(rf"^# TYPE ({_METRIC_NAME}) "
+                        r"(counter|gauge|histogram|summary|untyped)$")
+_SAMPLE_LINE = re.compile(
+    rf"^({_METRIC_NAME})"
+    rf"(?:\{{le=\"({_VALUE})\"\}})? ({_VALUE})$")
+
+
+def validate_exposition(text: str) -> None:
+    """Strict structural check of a text exposition-format page.
+
+    Raises ``ValueError`` on: a line matching neither the TYPE nor the
+    sample grammar (bad charset, malformed value — Python's ``nan``/
+    ``inf`` spellings included), a duplicate TYPE line for one metric,
+    ``le`` buckets out of ascending order, or a bucket series missing its
+    ``+Inf`` terminator.  Deliberately stricter than a scraper needs —
+    this is the round-trip oracle for :meth:`Metrics.render_prometheus`.
+    """
+    if not text.endswith("\n"):
+        raise ValueError("exposition page must end with a newline")
+    typed: set = set()
+    le_seen: Dict[str, float] = {}
+    for ln, line in enumerate(text.splitlines(), 1):
+        if not line:
+            continue
+        t = _TYPE_LINE.match(line)
+        if t:
+            if t.group(1) in typed:
+                raise ValueError(f"line {ln}: duplicate TYPE for "
+                                 f"{t.group(1)}")
+            typed.add(t.group(1))
+            continue
+        if line.startswith("#"):
+            continue   # HELP / comment lines are free-form
+        s = _SAMPLE_LINE.match(line)
+        if s is None:
+            raise ValueError(f"line {ln}: malformed sample: {line!r}")
+        name, le, _val = s.group(1), s.group(2), s.group(3)
+        if le is not None:
+            prev = le_seen.get(name)
+            cur = float(le)   # float() parses '+Inf'/'-Inf'/'NaN' natively
+            if math.isnan(cur):
+                raise ValueError(f"line {ln}: NaN le bucket")
+            if prev is not None and not cur > prev:
+                raise ValueError(f"line {ln}: le buckets not ascending "
+                                 f"for {name}")
+            le_seen[name] = cur
+    for name, top in le_seen.items():
+        if top != math.inf:
+            raise ValueError(f"bucket series {name} missing +Inf")

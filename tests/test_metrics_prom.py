@@ -1,0 +1,114 @@
+"""Prometheus exposition hygiene: non-finite guards, label escaping, and
+the strict round-trip validator (ISSUE 3 satellites)."""
+
+import math
+import time
+
+import pytest
+
+from rafting_tpu.utils.metrics import (
+    Metrics, escape_label_value, validate_exposition,
+)
+
+
+def _registry():
+    m = Metrics()
+    m.inc("commits", 5)
+    m.inc("weird name-with.chars", 2)
+    m.gauge("groups_led", 3)
+    for v in (1e-6, 0.5, 2.0, 130.0):
+        m.observe("tick_latency_s", v)
+    return m
+
+
+def test_render_round_trips_strict_validator():
+    text = _registry().render_prometheus()
+    validate_exposition(text)   # raises on any malformation
+    assert "raft_commits_total 5" in text
+    assert "raft_weird_name_with_chars_total 2" in text
+    assert 'le="+Inf"' in text
+
+
+def test_nonfinite_gauges_render_canonically():
+    m = _registry()
+    m.gauge("rate", float("nan"))
+    m.gauge("hi", float("inf"))
+    m.gauge("lo", float("-inf"))
+    text = m.render_prometheus()
+    # Python's spellings would be 'nan'/'inf' — the format wants these:
+    assert "raft_rate NaN" in text
+    assert "raft_hi +Inf" in text
+    assert "raft_lo -Inf" in text
+    validate_exposition(text)
+
+
+def test_nonfinite_histogram_sum_guarded():
+    m = Metrics()
+    m.observe("h", float("inf"))
+    text = m.render_prometheus()
+    assert "raft_h_sum +Inf" in text
+    validate_exposition(text)
+
+
+def test_validator_rejects_malformations():
+    good = _registry().render_prometheus()
+    # Duplicate TYPE line.
+    dup = good + "# TYPE raft_commits_total counter\n"
+    with pytest.raises(ValueError, match="duplicate TYPE"):
+        validate_exposition(dup)
+    # Bad charset in a metric name.
+    with pytest.raises(ValueError, match="malformed"):
+        validate_exposition("bad-name 1\n")
+    # Python float spellings are not valid exposition values.
+    with pytest.raises(ValueError, match="malformed"):
+        validate_exposition("raft_x nan\n")
+    # Unsorted le buckets.
+    bad = ('# TYPE h histogram\n'
+           'h_bucket{le="2"} 1\n'
+           'h_bucket{le="1"} 2\n'
+           'h_bucket{le="+Inf"} 2\n')
+    with pytest.raises(ValueError, match="not ascending"):
+        validate_exposition(bad)
+    # Bucket series missing its +Inf terminator.
+    with pytest.raises(ValueError, match=r"missing \+Inf"):
+        validate_exposition('h_bucket{le="1"} 1\n')
+    # Missing trailing newline.
+    with pytest.raises(ValueError, match="newline"):
+        validate_exposition("x 1")
+
+
+def test_escape_label_value():
+    assert escape_label_value('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+    assert escape_label_value("plain") == "plain"
+
+
+def test_windowed_rates_report_current_not_historical():
+    m = Metrics()
+    m._t0 -= 100.0          # pretend the node has been up 100 s
+    m.inc("commits", 1000)  # ancient history
+    m.checkpoint()
+    m.inc("commits", 10)    # the current window
+    life = m.rates()["commits_per_sec"]
+    cur = m.rates(since_last=True)["commits_per_sec"]
+    assert life < 11        # lifetime average diluted by the 100 s
+    assert cur > 100        # windowed rate sees only the fresh 10
+    # checkpoint() moves the baseline forward.
+    m.checkpoint()
+    assert m.rates(since_last=True)["commits_per_sec"] < 1e6
+    time.sleep(0.01)
+    m.inc("commits", 1)
+    assert 0 < m.rates(since_last=True)["commits_per_sec"] < 1000
+
+
+def test_windowed_rates_cover_absolute_set_counters():
+    """The runtime sets some counters absolutely (m['commits'] = total);
+    the windowed delta must still be the in-window movement."""
+    m = Metrics()
+    m["frontier"] = 500
+    m.checkpoint()
+    m["frontier"] = 530
+    r = m.rates(since_last=True)["frontier_per_sec"]
+    assert r > 0
+    # Lifetime rate would have counted all 530.
+    assert m.rates()["frontier_per_sec"] > r * 0  # both defined
+    assert not math.isnan(r)

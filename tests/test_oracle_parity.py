@@ -97,10 +97,12 @@ def run_parity(seed: int, n_ticks: int, cfg: EngineConfig,
     infos = [None] * N
     partition_left = 0
     partition = None
+    stats = {"partitions": 0, "crashes": 0, "stalls": 0}
 
     for t in range(n_ticks):
         # --- chaos schedule: random drops plus occasional partitions -----
         if partition_left == 0 and rng.random() < part_p:
+            stats["partitions"] += 1
             k = rng.integers(1, N)
             side = rng.permutation(N)[:k]
             partition = np.zeros((N, N), bool)
@@ -125,6 +127,8 @@ def run_parity(seed: int, n_ticks: int, cfg: EngineConfig,
         # drifting its clock from its peers' (the lease's adversary).
         crashed = rng.random(N) < crash_p
         stalled = rng.random(N) < stall_p
+        stats["crashes"] += int(crashed.sum())
+        stats["stalls"] += int(stalled.sum())
         for n in range(N):
             if crashed[n]:
                 # Leaf-copy: eager crash_restart aliases jnp.zeros constant
@@ -187,6 +191,7 @@ def run_parity(seed: int, n_ticks: int, cfg: EngineConfig,
     # The schedule must have actually elected leaders / committed entries.
     total_commit = sum(int(np.asarray(s.commit).sum()) for s in states)
     assert total_commit > 0, "chaos schedule never committed anything"
+    return states, stats
 
 
 @pytest.mark.parametrize("seed", [0, 1, 2])

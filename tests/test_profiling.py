@@ -58,3 +58,56 @@ def test_tick_profiler_idempotent_lifecycle(tmp_path):
     p.arm(str(tmp_path / "x"), 0)   # zero budget -> stays disarmed
     assert not p._active
     p.close()           # closing a disarmed profiler is fine
+
+
+def test_from_env_unset_stays_disarmed(monkeypatch):
+    monkeypatch.delenv("RAFT_PROFILE_DIR", raising=False)
+    monkeypatch.delenv("RAFT_PROFILE_TICKS", raising=False)
+    p = TickProfiler.from_env()
+    assert not p._active
+    p.close()
+
+
+def test_from_env_arms_with_budget(tmp_path, monkeypatch):
+    """The env-armed path: RAFT_PROFILE_DIR arms, RAFT_PROFILE_TICKS sets
+    the bounded budget, and the trace flushes on close()."""
+    d = str(tmp_path / "envtrace")
+    monkeypatch.setenv("RAFT_PROFILE_DIR", d)
+    monkeypatch.setenv("RAFT_PROFILE_TICKS", "3")
+    p = TickProfiler.from_env()
+    try:
+        assert p._active and p._remaining == 3
+        # A second env-armed profiler must skip (process-global trace).
+        p2 = TickProfiler.from_env()
+        assert not p2._active
+        for t in range(3):
+            with p.step(t):
+                pass
+            p.after_tick()
+        assert not p._active   # budget exhausted -> self-stopped
+        assert glob.glob(d + "/**/*.xplane.pb", recursive=True)
+    finally:
+        p.close()
+
+
+def test_profiler_disarms_on_node_close(tmp_path):
+    """A node closed mid-capture must stop the process-global trace (and
+    flush it) so the next node/profiler in the process can arm."""
+    cfg = EngineConfig(n_groups=8, n_peers=3)
+    trace_dir = str(tmp_path / "trace")
+    c = LocalCluster(cfg, str(tmp_path / "data"), seed=1)
+    try:
+        c.wait_leader(0)
+        node = c.nodes[0]
+        node.profile_ticks(trace_dir, n_ticks=1000)  # never self-exhausts
+        c.tick(3)
+        assert node.profiler._active
+    finally:
+        c.close()
+    assert not node.profiler._active
+    assert glob.glob(trace_dir + "/**/*.xplane.pb", recursive=True)
+    # The global owner slot is free again: a fresh profiler can arm.
+    p = TickProfiler()
+    p.arm(str(tmp_path / "again"), 2)
+    assert p._active
+    p.close()

@@ -7,6 +7,11 @@ This tool answers WHERE: it runs one bench_runtime scale with cProfile
 and prints the top functions by cumulative and by self time, so an
 optimization round targets the measured wall instead of a guessed one.
 
+Each run also persists its top-N tables (plus the bench result) as a
+JSON artifact under ``artifacts/`` via tools/_artifact.py, so profile
+shape is DIFFABLE across rounds — "what got slower since round 4" is a
+file comparison, not scrollback archaeology.
+
 Usage: tools/profile_runtime.py [n_groups] [rounds]
 """
 
@@ -17,27 +22,57 @@ import sys
 
 sys.path.insert(0, ".")
 
+TOP_N = 35
+
+
+def top_rows(stats: pstats.Stats, key: str, n: int = TOP_N) -> list:
+    """Extract the top-n functions by ``key`` as JSON-ready rows."""
+    stats.sort_stats(key)
+    rows = []
+    for func in stats.fcn_list[:n]:
+        cc, nc, tt, ct, _callers = stats.stats[func]
+        fname, line, name = func
+        rows.append({
+            "func": f"{fname}:{line}({name})",
+            "ncalls": nc,
+            "primitive_calls": cc,
+            "tottime_s": round(tt, 6),
+            "cumtime_s": round(ct, 6),
+        })
+    return rows
+
 
 def main() -> None:
     import jax
     jax.config.update("jax_platforms", "cpu")
     from bench_runtime import run
+    from tools._artifact import PhaseLog
 
     n_groups = int(sys.argv[1]) if len(sys.argv) > 1 else 32_768
     rounds = int(sys.argv[2]) if len(sys.argv) > 2 else 0
+    log = PhaseLog("profile_runtime", seed=0,
+                   config={"n_groups": n_groups, "rounds": rounds})
     prof = cProfile.Profile()
     prof.enable()
     res = run(n_groups=n_groups, rounds=rounds)
     prof.disable()
     print(res)
+    log.phase("bench", commits_per_sec=res["value"],
+              rounds=res["rounds"],
+              p99_tick_s=res["tick_latency"].get("p99_s", 0))
+    st = pstats.Stats(prof)
     for key in ("cumulative", "tottime"):
         s = io.StringIO()
-        pstats.Stats(prof, stream=s).sort_stats(key).print_stats(35)
+        pstats.Stats(prof, stream=s).sort_stats(key).print_stats(TOP_N)
         print(f"\n==== top by {key} ====")
         # Strip the long header boilerplate, keep the table.
         lines = s.getvalue().splitlines()
         start = next(i for i, l in enumerate(lines) if "ncalls" in l)
         print("\n".join(lines[start - 2:start + 40]))
+        rows = top_rows(st, key)
+        log.phase(f"top_{key}", shown=len(rows))
+        log.phases[-1]["rows"] = rows
+    log.save(platform="cpu")
 
 
 if __name__ == "__main__":
