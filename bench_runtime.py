@@ -52,7 +52,10 @@ def _shape(n_groups: int):
 
 
 def run(n_groups: int = 1024, rounds: int = 0, burst_n: int = 0,
-        transport: str = "loopback") -> dict:
+        transport: str = "loopback", pipeline=None) -> dict:
+    """``pipeline``: True/False forces the durable pipeline on/off for
+    every node; None uses the runtime default (RAFT_PIPELINE env if set,
+    else on only for accelerator engine backends — see RaftNode)."""
     from rafting_tpu.core.types import EngineConfig, LEADER
     from rafting_tpu.testkit.fixtures import NullProvider
     from rafting_tpu.testkit.harness import LocalCluster
@@ -76,7 +79,7 @@ def run(n_groups: int = 1024, rounds: int = 0, burst_n: int = 0,
         election_ticks=10, heartbeat_ticks=3, rpc_timeout_ticks=8)
     root = tempfile.mkdtemp(prefix="bench-runtime-")
     c = LocalCluster(cfg, root, provider_factory=NullProvider, seed=0,
-                     transport=transport)
+                     transport=transport, pipeline=pipeline)
     payload = b"x" * 64
     burst = [payload] * burst_n
 
@@ -95,7 +98,14 @@ def run(n_groups: int = 1024, rounds: int = 0, burst_n: int = 0,
 
     try:
         c.wait_leader(0, max_rounds=300)
-        c.tick(20)
+        # Settle until EVERY group elected (condition-driven: the
+        # pipelined runtime adds one tick of message latency, so a fixed
+        # settle count that worked serially under-waits at 32k+ groups).
+        for _ in range(40):
+            c.tick(5)
+            roles = np.stack([m.h_role for m in c.nodes.values()])
+            if (roles == LEADER).any(axis=0).all():
+                break
         leaders = np.array([c.leader_of(g) if c.leader_of(g) is not None
                             else -1 for g in range(n_groups)])
         assert (leaders >= 0).all()
@@ -111,6 +121,8 @@ def run(n_groups: int = 1024, rounds: int = 0, burst_n: int = 0,
         # durable tier is actually judged on.
         for n in c.nodes.values():
             n.metrics.histogram("tick_latency_s").reset()
+            for stage in n.metrics.breakdown():
+                n.metrics.histogram(f"tick_stage_{stage}").reset()
             # Windowed-rate baseline: rates(since_last=True) below then
             # reports measure-phase throughput, not a lifetime average
             # diluted by election warmup + compile ticks.
@@ -140,6 +152,15 @@ def run(n_groups: int = 1024, rounds: int = 0, burst_n: int = 0,
         applies_ps = max((n.metrics.rates(since_last=True)
                           .get("applies_per_sec", 0.0))
                          for n in c.nodes.values())
+        # Per-stage tick breakdown (scan-wait / wal / fsync / send / apply
+        # / maintain) from the slowest node — measure-phase only, mean
+        # seconds per tick — so a regression shows WHERE the tick went,
+        # not just that it got slower.  The same histograms back the
+        # /metrics exposition (runtime/obsrv.py).
+        slow = max(c.nodes.values(),
+                   key=lambda n: n.metrics.histogram("tick_latency_s").total)
+        stages = {k: round(v["mean"], 6)
+                  for k, v in slow.metrics.breakdown().items()}
         return {
             "metric": f"durable-runtime commits/sec @{n_groups} groups "
                       f"(3 nodes, WAL fsync barrier, applies, {transport})",
@@ -148,7 +169,11 @@ def run(n_groups: int = 1024, rounds: int = 0, burst_n: int = 0,
             "vs_baseline": None,
             "burst_per_group": burst_n,
             "rounds": rounds,
+            "pipeline": bool(slow.pipeline),
+            "wal_shards": getattr(getattr(slow.store, "wal", None),
+                                  "n_shards", 1),
             "tick_latency": lat,
+            "tick_stages_mean_s": stages,
             "applies_per_sec_windowed": round(applies_ps),
         }
     finally:
@@ -172,5 +197,30 @@ if __name__ == "__main__":
         args.remove("--tcp")
         transport = "tcp"
     scales = [int(a) for a in args] or [1024]
+    import os
     for n in scales:
-        print(json.dumps(run(n_groups=n, transport=transport)), flush=True)
+        out = run(n_groups=n, transport=transport)
+        print(json.dumps(out), flush=True)
+        if os.environ.get("BENCH_PIPELINE", "") == "1":
+            # Serial-vs-pipelined A/B at the same scale: the headline run
+            # above used the backend-aware default, so only the OTHER
+            # mode is re-run (forced explicitly — on a CPU host the
+            # default is serial, and a None-vs-False comparison would
+            # silently measure serial against itself).  The comparison
+            # line reports the speedup plus both runs' per-stage tick
+            # breakdowns.
+            other = run(n_groups=n, transport=transport,
+                        pipeline=not out["pipeline"])
+            print(json.dumps(other), flush=True)
+            piped, serial = ((out, other) if out["pipeline"]
+                             else (other, out))
+            print(json.dumps({
+                "metric": f"durable pipeline speedup @{n} groups "
+                          f"({transport})",
+                "value": round(piped["value"] / max(serial["value"], 1), 3),
+                "unit": "x (pipelined / serial commits/sec)",
+                "pipelined_commits_per_sec": piped["value"],
+                "serial_commits_per_sec": serial["value"],
+                "pipelined_stages_mean_s": piped["tick_stages_mean_s"],
+                "serial_stages_mean_s": serial["tick_stages_mean_s"],
+            }), flush=True)

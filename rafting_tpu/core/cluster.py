@@ -161,10 +161,11 @@ def cluster_step_nemesis(cfg: EngineConfig, states: RaftState,
     return new_states, outboxes, infos
 
 
-@partial(jax.jit, static_argnums=(0, 3))
+@partial(jax.jit, static_argnums=(0, 3, 6))
 def auto_host_inbox(cfg: EngineConfig, states: RaftState, submit_n: jax.Array,
                     compact, prev_info: StepInfo,
-                    read_n: Optional[jax.Array] = None) -> HostInbox:
+                    read_n: Optional[jax.Array] = None,
+                    durable_lag: bool = False) -> HostInbox:
     """Build a HostInbox batch [N, ...] for the self-driving harness.
 
     Policy (the steady-state behavior of a host runtime whose state machines
@@ -182,6 +183,13 @@ def auto_host_inbox(cfg: EngineConfig, states: RaftState, submit_n: jax.Array,
     group per tick (the read-plane analog of ``submit_n``; only leaders
     with a free ReadIndex slot stamp them — unstamped offers are simply
     re-offered next tick by this self-driving policy).
+
+    ``durable_lag``: feed each node's PREVIOUS-tick log tail
+    (``prev_info.log_tail``) as ``HostInbox.durable_tail`` — the fused-scan
+    model of the pipelined runtime's one-tick durability barrier (a tick's
+    appends fsync while the next scan runs, so own-match counts only the
+    prior tick's tail).  Default False: writes are durable instantly, the
+    classic simulation assumption.
 
     ``compact``: False = never; True = every tick (the bench steady state);
     int K > 1 = every K ticks.  The cadence matters for laggard catch-up
@@ -215,6 +223,7 @@ def auto_host_inbox(cfg: EngineConfig, states: RaftState, submit_n: jax.Array,
             snap_done=info.snap_req,
             snap_idx=info.snap_req_idx,
             snap_term=info.snap_req_term,
+            durable_tail=info.log_tail if durable_lag else None,
         )
     return jax.vmap(one)(states, submit_n, read_n, prev_info)
 

@@ -22,8 +22,8 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
 
 from .types import (
-    EngineConfig, FaultSchedule, LogState, Messages, RaftState, StepInfo,
-    TraceState,
+    EngineConfig, FaultSchedule, HostInbox, LogState, Messages, RaftState,
+    StepInfo, TraceState,
 )
 
 # RaftState fields with no group axis: per-node scalars and the PRNG key.
@@ -61,6 +61,18 @@ def messages_pspecs() -> Messages:
 def info_pspecs() -> StepInfo:
     return StepInfo(**{f.name: _NODE_GROUP
                        for f in dataclasses.fields(StepInfo)})
+
+
+def host_pspecs(durable: bool = False) -> HostInbox:
+    """Specs for a stacked [N, ...] HostInbox (callers that device_put a
+    pre-built inbox instead of folding ``auto_host_inbox`` into the scan).
+    ``read_veto`` is a per-node scalar; ``durable`` must match whether the
+    inbox carries the durable-tail feedback lane (a None subtree needs a
+    None spec, exactly like the trace lanes in :func:`state_pspecs`)."""
+    kw = {f.name: _NODE_GROUP for f in dataclasses.fields(HostInbox)}
+    kw["read_veto"] = _NODE
+    kw["durable_tail"] = _NODE_GROUP if durable else None
+    return HostInbox(**kw)
 
 
 # Non-pytree cluster inputs.

@@ -30,11 +30,12 @@ from .types import EngineConfig, FaultSchedule, Messages, RaftState, StepInfo
 
 def _scan_ticks(cfg: EngineConfig, n_ticks: int, states: RaftState,
                 inflight: Messages, prev_info: StepInfo, conn: jax.Array,
-                submit_n: jax.Array, read_n=None
+                submit_n: jax.Array, read_n=None, durable_lag: bool = False
                 ) -> Tuple[RaftState, Messages, StepInfo]:
     def body(carry, _):
         states, inflight, info = carry
-        host = auto_host_inbox(cfg, states, submit_n, True, info, read_n)
+        host = auto_host_inbox(cfg, states, submit_n, True, info, read_n,
+                               durable_lag)
         states, inflight, info = cluster_step(cfg, states, inflight, host,
                                               conn)
         return (states, inflight, info), ()
@@ -44,23 +45,27 @@ def _scan_ticks(cfg: EngineConfig, n_ticks: int, states: RaftState,
     return states, inflight, info
 
 
-@partial(jax.jit, static_argnums=(0, 1), donate_argnums=(2, 3, 4))
+@partial(jax.jit, static_argnums=(0, 1, 8), donate_argnums=(2, 3, 4))
 def run_cluster_ticks(cfg: EngineConfig, n_ticks: int, states: RaftState,
                       inflight: Messages, prev_info: StepInfo,
                       conn: jax.Array, submit_n: jax.Array,
-                      read_n=None) -> Tuple[RaftState, Messages, StepInfo]:
+                      read_n=None, durable_lag: bool = False
+                      ) -> Tuple[RaftState, Messages, StepInfo]:
     """Advance the cluster `n_ticks` ticks under a constant offered load.
 
     ``submit_n`` is [N, G]: commands offered to every node each tick (only
     leaders accept).  ``read_n`` (optional, [N, G]) additionally offers
     linearizable read batches each tick (read plane, core/step.py phase
-    8b; reads never touch the log).  Returns the final carry; per-tick
+    8b; reads never touch the log).  ``durable_lag`` (static) feeds each
+    tick's ``HostInbox.durable_tail`` from the previous tick's log tail —
+    the in-scan model of the pipelined runtime's one-tick durability
+    barrier (see ``auto_host_inbox``).  Returns the final carry; per-tick
     outputs are not materialized (the benchmark reads commit deltas from
     the state — for read-plane accounting use
     :func:`run_cluster_ticks_reads`).
     """
     return _scan_ticks(cfg, n_ticks, states, inflight, prev_info, conn,
-                       submit_n, read_n)
+                       submit_n, read_n, durable_lag)
 
 
 @partial(jax.jit, static_argnums=(0, 1), donate_argnums=(2, 3, 4))

@@ -773,7 +773,18 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     # Pallas scan when cfg.use_pallas (ops/quorum.py), else inline jnp —
     # identical semantics either way.
     from ..ops.quorum import quorum_commit
-    match_full = jnp.where(self_hot, log.last[:, None], match_idx)
+    # Own-match durability gate (HostInbox.durable_tail): with the
+    # pipelined runtime, this scan may be executing while the PREVIOUS
+    # tick's WAL fsync is still in flight — so the self column counts only
+    # the fsynced prefix, never the raw device tail.  An entry therefore
+    # needs (majority - 1) durable FOLLOWER acks plus OUR durable copy
+    # before it can commit — the ack-after-fsync contract, enforced
+    # in-kernel rather than by host-phase ordering alone.  None (every
+    # fused-scan path, and the serial runtime's default) keeps the
+    # classic self = log.last.
+    self_match = log.last if host.durable_tail is None \
+        else jnp.minimum(log.last, host.durable_tail)
+    match_full = jnp.where(self_hot, self_match[:, None], match_idx)
     commit = quorum_commit(cfg, match_full, log, commit, own_from,
                            active & (role == LEADER))
     match_idx = match_full

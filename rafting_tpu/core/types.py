@@ -21,7 +21,7 @@ Index conventions
 from __future__ import annotations
 
 import dataclasses
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -573,6 +573,16 @@ class HostInbox:
                                #   cannot stretch the lease window (the host
                                #   analog of the device model's
                                #   stall-loses-inbound rule)
+    # Durable-tail feedback (the pipelined runtime's safety lane): the
+    # highest log index per group the host has FSYNCED.  When present, the
+    # commit quorum counts this node's own match only up to it — an entry
+    # is never self-acked ahead of its durability barrier, so a scan
+    # dispatched concurrently with the previous tick's WAL fsync cannot
+    # commit (and hence the host cannot ack) an un-fsynced range.  None
+    # (the default, and what every fused-scan path feeds) = the device
+    # log tail is durable the moment it is written — the serial runtime's
+    # invariant, unchanged.
+    durable_tail: Optional[jax.Array] = None   # [G] int32, or None
 
     @classmethod
     def empty(cls, cfg: EngineConfig) -> "HostInbox":
@@ -585,6 +595,7 @@ class HostInbox:
             compact_to=jnp.zeros((G,), I32),
             read_n=jnp.zeros((G,), I32),
             read_veto=jnp.asarray(False),
+            durable_tail=None,
         )
 
 

@@ -35,8 +35,14 @@ from .wal import WalStore
 
 class LogStore:
     def __init__(self, path: str, segment_bytes: int = 64 << 20, *,
-                 force_python: bool = False):
-        self.wal = WalStore(path, segment_bytes, force_python=force_python)
+                 force_python: bool = False, shards: int = 1):
+        """``shards`` > 1 stripes groups over that many independent WAL
+        engines (log/wal.py ShardedWal): appends land as one arena call
+        per moved stripe and :meth:`sync` fsyncs the stripes in parallel
+        behind a single barrier.  The count is pinned in the directory at
+        creation, so recovery always reads the written layout."""
+        self.wal = WalStore(path, segment_bytes, force_python=force_python,
+                            shards=shards)
         # group -> ([run starts], [PayloadRun]) sorted by start: the hot
         # mirror of the live window as contiguous arena runs — the same
         # currency the wire codec and the staging path speak, so cache
@@ -250,6 +256,19 @@ class LogStore:
             return
         self.wal.append_stable(g, term, ballot)
         self._stable[g] = (term, ballot)
+
+    def put_stable_batch(self, groups, terms, ballots) -> None:
+        """Stage many (term, ballot) records in one store call (the
+        runtime's change-detected sweep hands over every moved lane at
+        once; steady state is an empty call)."""
+        st = self._stable
+        append = self.wal.append_stable
+        for g, t, b in zip(groups, terms, ballots):
+            g, t, b = int(g), int(t), int(b)
+            if st.get(g) == (t, b):
+                continue
+            append(g, t, b)
+            st[g] = (t, b)
 
     def set_floor(self, g: int, index: int, term: int) -> None:
         """Raise the compaction floor (snapshot milestone)."""

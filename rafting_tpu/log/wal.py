@@ -7,6 +7,26 @@ this environment supports (no pybind11).  ``PyWal`` reimplements the same
 contract in pure Python for platforms without a compiler; both backends
 read/write the identical on-disk format (cross-checked in
 tests/test_wal.py).
+
+Durability contract (the ack-after-fsync rule every engine here obeys):
+``append_*``/``truncate``/``milestone``/``append_stable`` only STAGE
+records — nothing is durable, and the caller must not acknowledge
+anything that depends on a staged record, until :meth:`sync` returns.
+One ``sync`` is the fsync barrier covering every record staged before it;
+the node runtime releases RPC replies and completes client futures only
+behind that barrier (persist-before-reply, amortized over all groups),
+and the pipelined runtime additionally feeds the post-barrier durable
+tail back into the device scan so an un-fsynced range can never be
+self-acked into a commit quorum (core/types.py HostInbox.durable_tail).
+
+``ShardedWal`` stripes groups over S independent engines (group ->
+shard ``g % S``), each with its own segment files and fsync: a tick's
+appends land as one arena call per moved stripe and ``sync`` issues the
+S fsyncs in parallel from a small worker pool with a single barrier
+join — the barrier completes only when EVERY shard's fsync has, so the
+ack-after-fsync contract is unchanged.  The stripe count is pinned in a
+``wal_shards.json`` meta file at creation; reopening honors the pinned
+value, so recovery can never silently read a half-striped directory.
 """
 
 from __future__ import annotations
@@ -629,9 +649,242 @@ def _signed(v: int) -> int:
     return v - (1 << 64) if v >= (1 << 63) else v
 
 
+_SHARD_META = "wal_shards.json"
+
+
+class ShardedWal:
+    """S independent WAL engines keyed by group stripe (``g % S``).
+
+    Same surface as ``_NativeWal``/``PyWal``.  Groups are disjoint across
+    shards, so every per-group operation routes to exactly one engine and
+    recovery is the union of per-shard replays (torn-tail truncation runs
+    per shard file, as ever).  ``sync`` fans the S fsyncs out to a worker
+    pool and joins them — one barrier, S spindles' worth of parallelism.
+    """
+
+    def __init__(self, path: str, segment_bytes: int, shards: int, *,
+                 force_python: bool = False):
+        from concurrent.futures import ThreadPoolExecutor
+
+        assert shards >= 1
+        self.dir = path
+        self.n_shards = shards
+        os.makedirs(path, exist_ok=True)
+        self.engines = []
+        for k in range(shards):
+            sub = os.path.join(path, f"shard{k:02d}")
+            if not force_python and native_available():
+                self.engines.append(_NativeWal(sub, segment_bytes))
+            else:
+                self.engines.append(PyWal(sub, segment_bytes))
+        self._pool = ThreadPoolExecutor(
+            max_workers=min(shards, 8),
+            thread_name_prefix="wal-fsync") if shards > 1 else None
+        self._gc_active = [False] * shards
+
+    def _e(self, g):
+        return self.engines[g % self.n_shards]
+
+    # -- staging (routes to one shard) ---------------------------------
+    def append_entry(self, g, idx, term, payload: bytes):
+        self._e(g).append_entry(g, idx, term, payload)
+
+    def append_stable(self, g, term, ballot):
+        self._e(g).append_stable(g, term, ballot)
+
+    def truncate(self, g, frm):
+        self._e(g).truncate(g, frm)
+
+    def milestone(self, g, idx, term):
+        self._e(g).milestone(g, idx, term)
+
+    def reset(self, g):
+        self._e(g).reset(g)
+
+    def append_batch(self, groups, idxs, terms, payloads) -> None:
+        import numpy as np
+        n = len(groups)
+        if n == 0:
+            return
+        lens = np.fromiter((len(p) for p in payloads), np.uint32, n)
+        offs = np.zeros(n, np.uint64)
+        offs[1:] = np.cumsum(lens[:-1], dtype=np.uint64)
+        self.append_arena(groups, idxs, terms, b"".join(payloads), offs, lens)
+
+    def append_arena(self, groups, idxs, terms, blob, offs, lens) -> None:
+        """One arena call per MOVED stripe: the shared blob crosses into
+        each engine with that stripe's (group, idx, term, off, len)
+        columns — offsets stay absolute into the caller's blob, so no
+        bytes are copied or re-joined on the split."""
+        import numpy as np
+        n = len(lens)
+        if n == 0:
+            return
+        g_arr = np.ascontiguousarray(groups, np.uint32)
+        i_arr = np.ascontiguousarray(idxs, np.uint64)
+        t_arr = np.ascontiguousarray(terms, np.int64)
+        o_arr = np.ascontiguousarray(offs, np.uint64)
+        l_arr = np.ascontiguousarray(lens, np.uint32)
+        stripe = g_arr % np.uint32(self.n_shards)
+        for k in np.unique(stripe).tolist():
+            m = stripe == k
+            self.engines[k].append_arena(
+                g_arr[m], i_arr[m], t_arr[m], blob, o_arr[m], l_arr[m])
+
+    # -- the durability barrier ----------------------------------------
+    def sync(self):
+        """Parallel fsync across shards with a single barrier join:
+        returns only when EVERY shard is durable (any failure raises —
+        a partially durable barrier must never be acknowledged)."""
+        if self._pool is None:
+            self.engines[0].sync()
+            return
+        futs = [self._pool.submit(e.sync) for e in self.engines]
+        err = None
+        for f in futs:
+            try:
+                f.result()
+            except Exception as e:  # join ALL before raising
+                err = err or e
+        if err is not None:
+            raise err
+
+    # -- per-group reads -----------------------------------------------
+    def tail(self, g):
+        return self._e(g).tail(g)
+
+    def floor(self, g):
+        return self._e(g).floor(g)
+
+    def floor_term(self, g):
+        return self._e(g).floor_term(g)
+
+    def stable(self, g):
+        return self._e(g).stable(g)
+
+    def entry_term(self, g, idx):
+        return self._e(g).entry_term(g, idx)
+
+    def entry_payload(self, g, idx):
+        return self._e(g).entry_payload(g, idx)
+
+    # -- maintenance / GC ----------------------------------------------
+    def checkpoint(self):
+        for e in self.engines:
+            e.checkpoint()
+
+    def gc_begin(self) -> int:
+        """Begin on every shard; -1 (and full rollback) unless ALL shards
+        enter the frozen state — a half-begun GC would desynchronize the
+        runtime's single three-phase state machine."""
+        begun = []
+        for k, e in enumerate(self.engines):
+            if e.gc_begin() < 0:
+                for j in begun:
+                    self.engines[j].gc_abort()
+                    self._gc_active[j] = False
+                return -1
+            begun.append(k)
+            self._gc_active[k] = True
+        return len(begun)
+
+    def gc_rewrite(self) -> int:
+        total = 0
+        for k, e in enumerate(self.engines):
+            if not self._gc_active[k]:
+                continue
+            r = e.gc_rewrite()
+            if r < 0:
+                return -1
+            total += r
+        return total
+
+    def gc_finish(self) -> int:
+        rc = 0
+        for k, e in enumerate(self.engines):
+            if not self._gc_active[k]:
+                continue
+            r = e.gc_finish()
+            if r != 0:
+                rc = r
+            else:
+                self._gc_active[k] = False
+        return rc
+
+    def gc_abort(self) -> None:
+        for k, e in enumerate(self.engines):
+            e.gc_abort()
+            self._gc_active[k] = False
+
+    def segment_count(self):
+        return sum(e.segment_count() for e in self.engines)
+
+    def total_bytes(self):
+        return sum(e.total_bytes() for e in self.engines)
+
+    def live_bytes(self):
+        return sum(e.live_bytes() for e in self.engines)
+
+    def export_state(self, G: int, L: int) -> dict:
+        """Merged boot-time restore: shards hold disjoint group stripes,
+        so the union is a per-stripe masked copy of each shard's export."""
+        import numpy as np
+        out = _export_arrays(G, L)
+        gi = np.arange(G)
+        for k, e in enumerate(self.engines):
+            ex = e.export_state(G, L)
+            m = (gi % self.n_shards) == k
+            for name, arr in out.items():
+                arr[m] = ex[name][m]
+        return out
+
+    def close(self):
+        for e in self.engines:
+            e.close()
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+
+
+def _pin_shards(path: str, requested: int) -> int:
+    """Resolve the stripe count for a WAL directory: a pinned meta wins
+    (recovery must read the layout that was written); a legacy flat
+    directory with segments is S=1; otherwise pin the requested count."""
+    import json
+    meta = os.path.join(path, _SHARD_META)
+    try:
+        with open(meta) as f:
+            return max(1, int(json.load(f)["shards"]))
+    except (OSError, ValueError, KeyError):
+        pass
+    try:
+        has_flat = any(f.endswith(".wal") for f in os.listdir(path))
+    except OSError:
+        has_flat = False
+    if has_flat:
+        return 1
+    if requested > 1:
+        os.makedirs(path, exist_ok=True)
+        tmp = meta + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"shards": requested}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, meta)
+    return requested
+
+
 def WalStore(path: str, segment_bytes: int = 64 << 20, *,
-             force_python: bool = False):
-    """Open a WAL store at `path`, preferring the native engine."""
+             force_python: bool = False, shards: int = 1):
+    """Open a WAL store at `path`, preferring the native engine.
+
+    ``shards`` > 1 stripes groups over that many independent engines
+    (``ShardedWal``); the count is pinned in the directory's meta file,
+    so a restart recovers with the layout the data was written under
+    regardless of what the caller asks for."""
+    shards = _pin_shards(path, shards)
+    if shards > 1:
+        return ShardedWal(path, segment_bytes, shards,
+                          force_python=force_python)
     if not force_python and native_available():
         return _NativeWal(path, segment_bytes)
     return PyWal(path, segment_bytes)

@@ -192,6 +192,24 @@ class _ReadBatch:
         self.t_enq = t_enq
 
 
+class _TickCtx:
+    """One tick in flight through the durable pipeline.
+
+    Created by ``_dispatch`` holding device-array references (the scan may
+    still be executing); ``_fetch`` swaps them for host numpy arrays; the
+    host phase (``_host_phase``) consumes those.  Carrying the per-tick
+    inputs (inbox arrays, staged payload runs, offered counts) here is
+    what lets the NEXT scan dispatch before this tick's host work runs."""
+
+    __slots__ = (
+        # dispatch-time host inputs
+        "submit_n", "read_n", "staged_payloads", "arrays",
+        # device refs (dispatch) -> host arrays (fetch)
+        "info", "outbox", "term", "voted", "role", "leader", "commit",
+        "base", "base_term",
+    )
+
+
 class RaftNode:
     def __init__(self, cfg: EngineConfig, node_id: int, data_dir: str,
                  provider: MachineProvider,
@@ -203,7 +221,9 @@ class RaftNode:
                  total_queue_cap: int = 500_000,
                  busy_threshold: int = 1_000,
                  store=None,
-                 serializer=None):
+                 serializer=None,
+                 pipeline: Optional[bool] = None,
+                 wal_shards: Optional[int] = None):
         """``transport_factory(node, on_slice, snapshot_provider)`` builds
         the transport endpoint (TcpTransport / LoopbackTransport).
         ``initial_active`` masks which group lanes start open (default all;
@@ -215,7 +235,18 @@ class RaftNode:
         default is the durable segmented WAL under ``data_dir``.
         ``serializer``: CmdSerializer for command/result encoding across
         the leader-forward relay (api/serial.py; reference CmdSerializer,
-        support/serial/CmdSerializer.java:11-24) — default JSON."""
+        support/serial/CmdSerializer.java:11-24) — default JSON.
+        ``pipeline``: run the double-buffered durable pipeline (see
+        ``tick``).  Default: env RAFT_PIPELINE if set (0/false = serial),
+        else ON exactly when the engine runs on an accelerator backend —
+        there the fused scan is the dominant tick cost and overlapping it
+        with the host phase pays; on the CPU backend the scan is a small
+        slice of a host-bound tick, so the pipeline's +1-tick message
+        latency costs more than the overlap saves (measured 0.84x at 32k
+        groups — see BENCH_PIPELINE in bench_runtime.py for the A/B).
+        ``wal_shards``: stripe count for the default WAL store (ignored
+        when ``store`` is passed) — default from env RAFT_WAL_SHARDS,
+        else 4."""
         from ..api.serial import JsonSerializer
 
         self.cfg = cfg
@@ -223,9 +254,19 @@ class RaftNode:
         self.data_dir = data_dir
         self.serializer = serializer or JsonSerializer()
         os.makedirs(data_dir, exist_ok=True)
+        if pipeline is None:
+            env = os.environ.get("RAFT_PIPELINE", "").strip().lower()
+            if env:
+                pipeline = env not in ("0", "false", "no", "off")
+            else:
+                pipeline = jax.default_backend() != "cpu"
+        self.pipeline = bool(pipeline)
+        if wal_shards is None:
+            wal_shards = int(os.environ.get("RAFT_WAL_SHARDS", "4"))
 
         self.store = store if store is not None \
-            else LogStore(os.path.join(data_dir, "wal"))
+            else LogStore(os.path.join(data_dir, "wal"),
+                          shards=max(1, wal_shards))
         self.archive = SnapshotArchive(os.path.join(data_dir, "snapshots"))
         self.dispatcher = ApplyDispatcher(
             provider, self._payload,
@@ -379,6 +420,22 @@ class RaftNode:
         self.max_checkpoints_per_tick = min(1536, max(256,
                                                       cfg.n_groups // 32))
         self._ckpt_cursor = 0   # round-robin position for the cap above
+        # Off-thread checkpoint saves: the tick thread serializes the
+        # machine (single-writer rule — applies mutate it) and enqueues the
+        # archive copy/rotate to a small worker pool; completions are
+        # harvested next maintain pass, and only THEN does the milestone
+        # feed the compaction policy (a grant must never outrun its saved
+        # snapshot).  The queue is bounded: when full, remaining due groups
+        # simply stay due — backpressure, not loss.  _ckpt_inflight keeps
+        # at most ONE save in flight per group, so same-group archive
+        # ordering needs no worker sharding.
+        self._ckpt_cv = threading.Condition()
+        self._ckpt_queue: "deque[Tuple[int, str, int, int]]" = deque()
+        self._ckpt_done: List[Tuple[int, int, bool]] = []
+        self._ckpt_inflight: set = set()
+        self._ckpt_threads: List[threading.Thread] = []
+        self.ckpt_workers = 2
+        self.ckpt_queue_cap = 4 * self.max_checkpoints_per_tick
         # _gc_phase handoff protocol: the tick thread writes 0->1 (start),
         # the worker writes 1->2 or 1->-1 (done/failed), the tick thread
         # consumes 2/-1 back to 0.  Exactly one side may write in each
@@ -402,6 +459,18 @@ class RaftNode:
         self.profiler = TickProfiler.from_env()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Double-buffered pipeline state: the fetched-but-not-yet-host-
+        # processed tick (see tick()).  Owned by the tick thread.
+        self._pending: Optional[_TickCtx] = None
+        # Per-group offer counts riding the in-flight/pending tick, so the
+        # next dispatch never offers the same queued entry twice (the
+        # device accepting both would outrun the host queues).
+        self._inflight_submit = np.zeros(G, np.int32)
+        self._inflight_read = np.zeros(G, np.int32)
+        self.metrics.gauge("pipeline_enabled", int(self.pipeline))
+        self.metrics.gauge("wal_shards",
+                           getattr(getattr(self.store, "wal", None),
+                                   "n_shards", 1))
 
     # ------------------------------------------------------------------ API
 
@@ -431,10 +500,28 @@ class RaftNode:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
+        # Settle the pipeline: the pending tick's host work (WAL staging,
+        # fsync, sends, applies) runs here on the closing thread —
+        # single-writer ownership transfers exactly like the GC settle
+        # below — so nothing the device computed is lost on a graceful
+        # close and the durable tail matches the device tail on restart.
+        pending, self._pending = self._pending, None
+        if pending is not None:
+            try:
+                self._host_phase(pending)
+            except Exception:
+                log.exception("node %d: pipeline drain failed on close",
+                              self.node_id)
         if self._obsrv is not None:
             self._obsrv.close()
             self._obsrv = None
         self.transport.close()
+        # Checkpoint workers drain their queue after _stop (no serialized
+        # temp file is stranded), then exit.
+        with self._ckpt_cv:
+            self._ckpt_cv.notify_all()
+        for t in self._ckpt_threads:
+            t.join(timeout=30)
         # In-flight snapshot workers touch the store; they must finish (or
         # observe _stop) before the native WAL handle is released.
         with self._snap_cv:
@@ -724,19 +811,69 @@ class RaftNode:
         self.profiler.arm(log_dir, n_ticks)
 
     def tick(self) -> StepInfo:
-        with self.profiler.step(self.ticks):
-            info = self._tick_inner()
-        self.profiler.after_tick()
-        return info
+        """Advance the node one tick and return its StepInfo.
 
-    def _tick_inner(self) -> StepInfo:
+        Serial mode (``pipeline=False``): the classic strictly ordered
+        tick — scan, wait, persist+fsync, send, apply, maintain — nothing
+        overlaps.
+
+        Pipelined mode (the durable pipeline): this tick's fused scan is
+        DISPATCHED first (JAX async dispatch — no blocking transfer), the
+        PREVIOUS tick's host phase (WAL staging, the fsync barrier,
+        outbox release, applies, read serving, maintenance) runs while
+        the device computes, and only then are this tick's results
+        fetched.  Safety holds because (a) a tick's outbox and futures
+        are released only inside its own host phase, strictly after its
+        fsync barrier — ack-after-fsync, exactly as serial — and (b) the
+        scan's commit quorum counts our own match only up to the FSYNCED
+        durable tail fed through ``HostInbox.durable_tail``, so a scan
+        racing the previous tick's fsync can never self-ack an un-fsynced
+        range into a commit.  Pipeline barriers (lifecycle changes,
+        snapshot installs) drain the pending tick first; both are rare.
+        """
         _tick_t0 = time.perf_counter()
+        with self.profiler.step(self.ticks):
+            ctx = self._dispatch()
+            if self.pipeline:
+                prev, self._pending = self._pending, None
+                try:
+                    if prev is not None:
+                        self._host_phase(prev)
+                finally:
+                    # The dispatched tick must never be dropped: even if
+                    # the previous host phase failed (the loop in _run
+                    # keeps ticking through exceptions), fetch and stash
+                    # it so its appends are persisted next tick —
+                    # otherwise the device state advances past entries
+                    # whose payloads the WAL never saw.
+                    self._fetch(ctx)
+                    self._pending = ctx
+            else:
+                self._fetch(ctx)
+                self._host_phase(ctx)
+        self.metrics.observe("tick_latency_s",
+                             time.perf_counter() - _tick_t0)
+        self.profiler.after_tick()
+        return ctx.info
+
+    # ------------------------------------------------------- tick: dispatch
+
+    def _dispatch(self) -> _TickCtx:
         cfg = self.cfg
-        G, P = cfg.n_groups, cfg.n_peers
+        G = cfg.n_groups
 
         # -- 0. group lifecycle ----------------------------------------------
         with self._lifecycle_lock:
             changes, self._lifecycle = self._lifecycle, []
+        with self._snap_lock:
+            fetched, self._snap_fetched = self._snap_fetched, []
+        if (changes or fetched) and self._pending is not None:
+            # Pipeline barrier: purges and snapshot installs move the WAL
+            # floor / wipe lanes, which is only sound once every device-
+            # computed append is persisted (the serial invariant).  Both
+            # are rare catch-up/admin events; one overlap window is lost.
+            prev, self._pending = self._pending, None
+            self._host_phase(prev)
         if changes:
             act = np.asarray(self.state.active).copy()
             purged = []
@@ -764,20 +901,29 @@ class RaftNode:
         # -- 1. host inbox ---------------------------------------------------
         with self._submit_lock:
             # One vector op over the entry-count mirror — the dict walk
-            # was O(groups-with-queues) per tick.
-            submit_n = np.minimum(self._queued_n, cfg.max_submit)
+            # was O(groups-with-queues) per tick.  Offers already riding
+            # the pending (un-persisted) tick are subtracted: the device
+            # must never be offered the same queued entry twice, or the
+            # two accepts would outrun the host queues.
+            submit_n = np.minimum(
+                np.maximum(self._queued_n - self._inflight_submit, 0),
+                cfg.max_submit).astype(np.int32)
         # Read plane: promote one waiting batch per group into the offer
         # slot; an unstamped offer (no free device slot / not leader yet)
-        # simply stays offered and is re-offered next tick.
+        # simply stays offered and is re-offered next tick.  An offer
+        # riding the pending tick is masked out until that tick's harvest
+        # (a batch must reach the device exactly once per stamp attempt).
         read_n = np.zeros(G, np.int32)
         with self._read_lock:
             for g, q in self._reads_waiting.items():
-                if q and g not in self._reads_offered:
+                if q and g not in self._reads_offered \
+                        and not self._inflight_read[g]:
                     b = q.popleft()
                     self._read_queued_n[g] -= len(b.payloads)
                     self._reads_offered[g] = b
             for g, b in self._reads_offered.items():
-                read_n[g] = len(b.payloads)
+                if not self._inflight_read[g]:
+                    read_n[g] = len(b.payloads)
         # Wall-clock pause detection (HostInbox.read_veto contract): a gap
         # beyond read_fresh_ticks tick intervals invalidates stored lease
         # evidence AND whatever acks queued in the inbox across the pause.
@@ -801,12 +947,20 @@ class RaftNode:
         snap_done = np.zeros(G, bool)
         snap_idx = np.zeros(G, np.int32)
         snap_term = np.zeros(G, np.int32)
-        with self._snap_lock:
-            fetched, self._snap_fetched = self._snap_fetched, []
         for g, idx, term in self._install_snapshots(fetched):
             snap_done[g] = True
             snap_idx[g] = idx
             snap_term[g] = term
+        # Durability feedback (pipelined mode): the fsynced tail per
+        # group — every completed host phase ends with its fsync barrier,
+        # so the mirror is durable by construction at dispatch time.  The
+        # scan clamps its own commit-quorum match to it (core/step.py
+        # phase 10), making ack-after-fsync a kernel invariant rather
+        # than a host-ordering convention.
+        durable = None
+        if self.pipeline:
+            durable = jnp.asarray(np.minimum(
+                self._durable_tail_m, I32_SAFE_MAX).astype(np.int32))
         host = HostInbox(
             submit_n=jnp.asarray(submit_n),
             snap_done=jnp.asarray(snap_done),
@@ -815,6 +969,7 @@ class RaftNode:
             compact_to=jnp.asarray(self._compact_grant.astype(np.int32)),
             read_n=jnp.asarray(read_n),
             read_veto=jnp.asarray(read_veto),
+            durable_tail=durable,
         )
         self._compact_grant = np.zeros(G, np.int64)
 
@@ -822,15 +977,42 @@ class RaftNode:
         arrays, staged_payloads = self.acc.drain()
         inbox = Messages(**{k: jnp.asarray(v) for k, v in arrays.items()})
 
-        # -- 3. device step --------------------------------------------------
+        # -- 3. device step (async dispatch: no transfer, no block) ----------
         self.state, outbox, info = node_step(cfg, self.state, inbox, host)
 
+        ctx = _TickCtx()
+        ctx.submit_n, ctx.read_n = submit_n, read_n
+        ctx.staged_payloads, ctx.arrays = staged_payloads, arrays
+        ctx.info, ctx.outbox = info, outbox
+        ctx.term, ctx.voted = self.state.term, self.state.voted_for
+        ctx.role, ctx.leader = self.state.role, self.state.leader_id
+        ctx.commit = self.state.commit
+        ctx.base, ctx.base_term = self.state.log.base, self.state.log.base_term
+        self._inflight_submit = self._inflight_submit + submit_n
+        self._inflight_read = self._inflight_read + read_n
+        return ctx
+
+    # --------------------------------------------------------- tick: fetch
+
+    def _fetch(self, ctx: _TickCtx) -> None:
+        """Pull the dispatched scan's results to the host (the pipeline's
+        only blocking point) and refresh the per-tick mirrors.  In
+        pipelined mode this runs AFTER the previous tick's host phase, so
+        the wait here is whatever device time the host work did not
+        cover."""
+        cfg = self.cfg
+        _w0 = time.perf_counter()
         # One transfer for everything the host needs this tick.
         (h_info, h_out, h_term, h_voted, h_role, h_leader, h_commit, h_base,
          h_base_term) = jax.device_get(
-            (info, outbox, self.state.term, self.state.voted_for,
-             self.state.role, self.state.leader_id, self.state.commit,
-             self.state.log.base, self.state.log.base_term))
+            (ctx.info, ctx.outbox, ctx.term, ctx.voted, ctx.role,
+             ctx.leader, ctx.commit, ctx.base, ctx.base_term))
+        self.metrics.observe("tick_stage_scan_wait_s",
+                             time.perf_counter() - _w0)
+        ctx.info, ctx.outbox = h_info, h_out
+        ctx.term, ctx.voted, ctx.role = h_term, h_voted, h_role
+        ctx.leader, ctx.commit = h_leader, h_commit
+        ctx.base, ctx.base_term = h_base, h_base_term
 
         if cfg.debug_checks:
             from ..core.step import raise_debug_violations
@@ -874,29 +1056,7 @@ class RaftNode:
             # linearization point under any later leadership.
             self._reject_reads(g)
 
-        # -- 4. persistence barrier ------------------------------------------
-        self._persist(h_info, h_term, h_voted, h_leader, h_base, h_base_term,
-                      staged_payloads, arrays, submit_n)
-
-        # -- 5. release outbox ----------------------------------------------
-        self._send(h_out)
-
-        # -- 6. applies ------------------------------------------------------
-        before = self.dispatcher.applied_frontier(G)
-        self.dispatcher.advance(h_commit)
-        after = self.dispatcher.applied_frontier(G)
-        self.metrics["applies"] += int((after - before).sum())
-        self.metrics["commits"] = int(h_commit.astype(np.int64).sum())
-
-        # -- 6b. read plane: stamped/released bookkeeping + serving ----------
-        self._harvest_reads(h_info)
-        self._serve_reads(after)
-
-        # -- 7. maintain: checkpoints, compaction, snapshot downloads --------
-        self._maintain(after, h_base, h_term)
-        self._snapshot_requests(h_info, h_base)
-
-        # -- 8. flight-recorder drain ----------------------------------------
+        # -- flight-recorder drain -------------------------------------------
         # Opt-in with the recorder itself: decoded events feed per-group
         # timelines (HTTP /timeline) and the labeled metrics aggregate
         # counters cannot express (elections by cause, leader churn).
@@ -915,18 +1075,78 @@ class RaftNode:
                         self.metrics[k] += v
 
         self.ticks += 1
-        self.metrics.observe("tick_latency_s",
-                             time.perf_counter() - _tick_t0)
         self.metrics.gauge("groups_active", int(self.h_active.sum()))
         self.metrics.gauge(
             "groups_led", int((h_role == LEADER).sum()))
-        return h_info
+
+    # ---------------------------------------------------- tick: host phase
+
+    def _host_phase(self, ctx: _TickCtx) -> None:
+        """One fetched tick's host work: WAL staging, THE fsync barrier,
+        outbox release, applies + future completion, read serving,
+        maintenance.  Everything that acknowledges the tick runs here,
+        strictly after its barrier — in pipelined mode this whole phase
+        overlaps the next tick's device scan."""
+        G = self.cfg.n_groups
+        _t0 = time.perf_counter()
+        try:
+            # -- 4. persistence barrier --------------------------------------
+            need_sync = self._persist(
+                ctx.info, ctx.term, ctx.voted, ctx.leader, ctx.base,
+                ctx.base_term, ctx.staged_payloads, ctx.arrays, ctx.submit_n)
+            ctx.staged_payloads = ctx.arrays = None   # drop frame pins early
+            _t1 = time.perf_counter()
+            if need_sync:
+                self.store.sync()   # THE durability barrier
+            _t2 = time.perf_counter()
+
+            # -- 5. release outbox (only ever after the barrier) -------------
+            self._send(ctx.outbox)
+            _t3 = time.perf_counter()
+
+            # -- 6. applies --------------------------------------------------
+            before = self.dispatcher.applied_frontier(G)
+            self.dispatcher.advance(ctx.commit)
+            after = self.dispatcher.applied_frontier(G)
+            self.metrics["applies"] += int((after - before).sum())
+            self.metrics["commits"] = int(ctx.commit.astype(np.int64).sum())
+
+            # -- 6b. read plane: stamped/released bookkeeping + serving ------
+            self._harvest_reads(ctx.info)
+            self._serve_reads(after)
+            _t4 = time.perf_counter()
+
+            # -- 7. maintain: checkpoints, compaction, snapshot downloads ----
+            self._maintain(after, ctx.base, ctx.term)
+            self._snapshot_requests(ctx.info, ctx.base)
+            _t5 = time.perf_counter()
+
+            m = self.metrics
+            m.observe("tick_stage_wal_s", _t1 - _t0)
+            m.observe("tick_stage_fsync_s", _t2 - _t1)
+            m.observe("tick_stage_send_s", _t3 - _t2)
+            m.observe("tick_stage_apply_s", _t4 - _t3)
+            m.observe("tick_stage_maintain_s", _t5 - _t4)
+        finally:
+            # This tick's offers are settled even on failure: leaking the
+            # inflight counts would mask those groups from every future
+            # dispatch (queued commands never re-offered, futures hung).
+            # A mid-persist failure can instead re-offer an entry the
+            # device already accepted — a client-retry-style duplicate,
+            # strictly better than permanent starvation.
+            self._inflight_submit = self._inflight_submit - ctx.submit_n
+            self._inflight_read = self._inflight_read - ctx.read_n
 
     # ---------------------------------------------------------- persistence
 
     def _persist(self, info: StepInfo, h_term, h_voted, h_leader,
                  h_base, h_base_term, staged_payloads, inbox_arrays,
-                 submit_n) -> None:
+                 submit_n) -> bool:
+        """Stage the tick's durable writes (entries, stable records,
+        truncations, floors) into the WAL.  Returns whether anything was
+        staged — the caller issues the fsync barrier (``store.sync``)
+        and must not release the tick's outbox or complete futures
+        before it."""
         dirty_mask = np.asarray(info.dirty)
         app_from = np.asarray(info.appended_from)
         app_to = np.asarray(info.appended_to)
@@ -937,14 +1157,20 @@ class RaftNode:
 
         # (term, ballot) durable before any reply leaves (reference
         # RaftMember ctor persists first, context/member/RaftMember.java:
-        # 25).  Change-detected in numpy so the Python loop touches only
-        # lanes whose record actually moved (steady state: none).
+        # 25).  Change-detected in numpy and handed to the store as ONE
+        # batch of moved lanes (steady state: an empty call).
         st_changed = dirty_mask & ((h_term != self._stable_term_m)
                                    | (h_voted != self._stable_voted_m))
-        for g in np.nonzero(st_changed)[0].tolist():
-            self.store.put_stable(g, int(h_term[g]), int(h_voted[g]))
-            any_write = True
         if st_changed.any():
+            moved = np.nonzero(st_changed)[0]
+            put_batch = getattr(self.store, "put_stable_batch", None)
+            if put_batch is not None:
+                put_batch(moved.tolist(), h_term[moved].tolist(),
+                          h_voted[moved].tolist())
+            else:
+                for g in moved.tolist():
+                    self.store.put_stable(g, int(h_term[g]), int(h_voted[g]))
+            any_write = True
             self._stable_term_m[st_changed] = h_term[st_changed]
             self._stable_voted_m[st_changed] = h_voted[st_changed]
 
@@ -1130,18 +1356,17 @@ class RaftNode:
                 self._durable_tail_m[g] = h_base[g]
             wal_floors_moved = True
 
-        if any_write or wal_floors_moved:
-            self.store.sync()   # THE durability barrier
-
         # Submissions offered but refused because we are no longer leader:
         # fail fast with a redirect hint.  A still-leading group whose ring
         # is briefly full keeps its queue (backpressure, not rejection —
         # the reference distinguishes BusyLoop from NotLeader,
-        # support/anomaly/).
+        # support/anomaly/).  Refusals carry no durability dependency, so
+        # they may precede the caller's fsync barrier.
         rejected = np.nonzero((submit_n > 0) & (sub_acc < submit_n)
                               & (self.h_role != LEADER))[0]
         for g in rejected.tolist():
             self._reject_submissions(int(g))
+        return bool(any_write or wal_floors_moved)
 
     def _reject_submissions(self, g: int,
                             exc: Optional[Exception] = None) -> None:
@@ -1264,6 +1489,28 @@ class RaftNode:
         """Wipe destroyed lanes end to end: durable WAL state, machine,
         archived snapshots, and every device-side lane (term, log, vote,
         replication bookkeeping) back to boot values."""
+        lane_set = set(lanes)
+        # Settle the checkpoint pool for these lanes: drop queued saves,
+        # then wait out any in-flight one (bounded) — a worker's archive
+        # insert must not race destroy() and resurrect a dead snapshot.
+        with self._ckpt_cv:
+            if self._ckpt_queue:
+                self._ckpt_queue = deque(
+                    e for e in self._ckpt_queue if e[0] not in lane_set)
+            deadline = time.monotonic() + 10
+            while True:
+                pending = (self._ckpt_inflight & lane_set) \
+                    - {d[0] for d in self._ckpt_done}
+                if not pending:
+                    break
+                if time.monotonic() > deadline:
+                    log.error("purge: checkpoint save still in flight for "
+                              "%s after 10s", sorted(pending))
+                    break
+                self._ckpt_cv.wait(timeout=0.1)
+            self._ckpt_done = [d for d in self._ckpt_done
+                               if d[0] not in lane_set]
+        self._ckpt_inflight -= lane_set
         for g in lanes:
             self.store.reset_group(g)
             self.dispatcher.drop_machine(g, destroy=True)
@@ -1357,6 +1604,16 @@ class RaftNode:
 
     def _maintain(self, applied: np.ndarray, h_base, h_term) -> None:
         now = self.ticks
+        # Harvest completed off-thread saves FIRST: a milestone feeds the
+        # compaction policy only once its archive copy is durable on disk
+        # (a compaction grant must never outrun its snapshot).
+        with self._ckpt_cv:
+            done, self._ckpt_done = self._ckpt_done, []
+        for g, idx, ok in done:
+            self._ckpt_inflight.discard(g)
+            if ok:
+                self.maintain.note_checkpoint(g, now, idx)
+                self.metrics["snapshots_taken"] += 1
         need = self.maintain.need_checkpoint(now, applied, h_base)
         due = np.nonzero(need)[0]
         if len(due) > self.max_checkpoints_per_tick:
@@ -1367,26 +1624,77 @@ class RaftNode:
             due = due[:self.max_checkpoints_per_tick]
         if len(due):
             self._ckpt_cursor = int(due[-1])
+        # The tick thread only SERIALIZES the machine (single-writer rule:
+        # applies mutate it on this thread) and reads the snapshot term;
+        # the archive copy + rotation happen on the worker pool.  Bounded
+        # queue: when full, the remaining due groups simply stay due —
+        # backpressure, never loss — so maintenance can no longer own the
+        # tick latency (reference: checkpoints run on a bounded pool off
+        # the loop, RaftRoutine.java:46-49).
+        queued = False
         for g in due.tolist():
+            if g in self._ckpt_inflight:
+                continue   # one save in flight per group (archive order)
+            with self._ckpt_cv:
+                if len(self._ckpt_queue) >= self.ckpt_queue_cap:
+                    self.metrics["ckpt_backpressure"] += 1
+                    break
             try:
                 ckpt = self.dispatcher.machine(g).checkpoint(0)
             except Exception:
                 log.exception("checkpoint failed g=%d", g)
                 continue
-            # Snapshot term = term of the log entry at the checkpoint index.
+            # Snapshot term = term of the log entry at the checkpoint index
+            # (a store read — tick thread only, like every store access).
             t = self.store.entry_term(g, ckpt.index)
             if t < 0:
                 t = self.store.floor_term(g)
-            self.archive.save_checkpoint(g, ckpt.path, ckpt.index, t)
-            self.maintain.note_checkpoint(g, now, ckpt.index)
-            self.metrics["snapshots_taken"] += 1
-            try:
-                os.unlink(ckpt.path)
-            except OSError:
-                pass
+            self._ckpt_inflight.add(g)
+            with self._ckpt_cv:
+                self._ckpt_queue.append((g, ckpt.path, ckpt.index, t))
+                self._ckpt_cv.notify()
+                queued = True
+        if queued:
+            self._ensure_ckpt_workers()
         self._compact_grant = self.maintain.compact_targets(
             now, self.h_commit.astype(np.int64), h_base.astype(np.int64))
         self._maintain_gc(now)
+
+    def _ensure_ckpt_workers(self) -> None:
+        self._ckpt_threads = [t for t in self._ckpt_threads if t.is_alive()]
+        while len(self._ckpt_threads) < self.ckpt_workers:
+            t = threading.Thread(
+                target=self._ckpt_worker,
+                name=f"raft-ckpt-{self.node_id}-{len(self._ckpt_threads)}",
+                daemon=True)
+            t.start()
+            self._ckpt_threads.append(t)
+
+    def _ckpt_worker(self) -> None:
+        """Pool worker: archive machine checkpoints until shutdown (the
+        queue is drained even after _stop so no serialized temp file is
+        stranded un-archived)."""
+        while True:
+            with self._ckpt_cv:
+                while not self._ckpt_queue and not self._stop.is_set():
+                    self._ckpt_cv.wait(timeout=0.5)
+                if not self._ckpt_queue:
+                    return   # _stop set and nothing left
+                g, path, idx, term = self._ckpt_queue.popleft()
+            ok = True
+            try:
+                self.archive.save_checkpoint(g, path, idx, term)
+            except Exception:
+                log.exception("checkpoint archive failed g=%d", g)
+                ok = False
+            finally:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            with self._ckpt_cv:
+                self._ckpt_done.append((g, idx, ok))
+                self._ckpt_cv.notify_all()
 
     def _maintain_gc(self, now: int) -> None:
         """Physical WAL GC, three-phase so no tick stalls on the rewrite

@@ -48,19 +48,24 @@ class SnapshotArchive:
         self.retain = retain
         os.makedirs(root, exist_ok=True)
         self._pending: Dict[int, PendingSnapshot] = {}
-        # Hot-path caches: group dirs already created, and the newest
-        # snapshot per group.  Without them every checkpoint/serve does a
-        # makedirs + listdir + sort per call — at a 100k-group maintain
-        # cadence that is hundreds of redundant file ops per tick.
+        # Hot-path caches: group dirs already created, and the FULL sorted
+        # snapshot manifest per group.  The manifest makes checkpoint
+        # rotation O(1): save_checkpoint appends to it and prunes from its
+        # head instead of re-listing and re-stat'ing the directory on
+        # every call (the listdir+stat retention storm was 16k+ posix.stat
+        # calls per durable bench run).  A group's manifest is warmed once
+        # (first touch after open) and maintained by every mutation; the
+        # directory is re-read only on that first touch.
         self._dirs: set = set()
-        self._newest: Dict[int, Optional[Snapshot]] = {}
+        self._manifest: Dict[int, List[Snapshot]] = {}
         # Per-group incarnation counter bumped by destroy(), plus the lock
         # that makes check-gen-then-cache atomic against it: a cache-miss
         # read that overlapped a destroy must not write its (now dead)
-        # listing back into _newest — see last_snapshot.  The lock guards
-        # ONLY the miss write-back and destroy's pop+bump (cache hits stay
-        # lock-free; a hit racing destroy is the pre-existing bounded
-        # hand-out-then-check-exists race every caller already handles).
+        # listing back into the manifest — see last_snapshot.  The same
+        # lock orders manifest mutations from the checkpoint worker pool
+        # (runtime/node.py off-thread saves) against the tick thread's
+        # installs/serves; manifest critical sections are a few list ops —
+        # file I/O (copy, unlink) always happens outside it.
         self._gen: Dict[int, int] = {}
         self._gen_lock = threading.Lock()
         # Sweep temp droppings from interrupted installs.
@@ -91,7 +96,12 @@ class SnapshotArchive:
     def save_checkpoint(self, g: int, src_path: str, index: int,
                         term: int) -> Snapshot:
         """Archive a machine checkpoint as the group's newest snapshot
-        (atomic move; ordering asserted like SnapshotArchive.java:138-182)."""
+        (atomic move; ordering asserted like SnapshotArchive.java:138-182).
+
+        Safe off the tick thread: the node runtime runs local checkpoint
+        saves on its worker pool (group-sharded, so one group's saves
+        stay ordered); the manifest keeps rotation O(1) — no listdir or
+        per-file stat on this path, ever."""
         last = self.last_snapshot(g)
         if last is not None:
             assert (term, index) >= (last.term, last.index), \
@@ -100,46 +110,66 @@ class SnapshotArchive:
             if (index, term) == (last.index, last.term):
                 return last
         dst = os.path.join(self._gdir(g), f"snapshot_{index:016x}_{term:016x}")
-        tmp = dst + ".tmp"
+        # Writer-unique temp name (still *.tmp so the open() sweep catches
+        # droppings): a tick-thread install and a pool worker's save must
+        # never collide on one temp path.
+        tmp = f"{dst}.{threading.get_ident()}.tmp"
         shutil.copyfile(src_path, tmp)
         os.replace(tmp, dst)
-        self._prune(g)
         snap = Snapshot(dst, index, term)
-        self._newest[g] = snap
+        with self._gen_lock:
+            m = self._manifest.setdefault(g, [])
+            if not m or (snap.term, snap.index) > (m[-1].term, m[-1].index):
+                m.append(snap)
+            drop, self._manifest[g] = m[:-self.retain], m[-self.retain:]
+        for s in drop:
+            try:
+                os.unlink(s.path)
+            except OSError:
+                pass
         return snap
 
-    _MISS = object()
-
     def last_snapshot(self, g: int) -> Optional[Snapshot]:
-        # Single .get read: the snapshot-serving transport thread calls
-        # this concurrently with the tick thread's destroy(), so a
-        # check-then-index pair could land between the two and KeyError.
-        snap = self._newest.get(g, self._MISS)
-        if snap is not self._MISS:
-            return snap
-        gen = self._gen.get(g, 0)
-        snaps = self.list_snapshots(g)
-        snap = snaps[-1] if snaps else None
+        with self._gen_lock:
+            m = self._manifest.get(g)
+            if m is not None:
+                return m[-1] if m else None
+            gen = self._gen.get(g, 0)
+        snaps = self._scan_dir(g)
         # The gen check and the write-back must be ONE atomic step (under
         # _gen_lock, paired with destroy's pop+bump): a bare
         # check-then-setdefault leaves a preemption window in which
         # destroy() completes between the two and the dead listing gets
         # cached anyway — handing out a deleted path and pinning a stale
-        # Snapshot that a recreated group's save_checkpoint would trip
+        # manifest that a recreated group's save_checkpoint would trip
         # its ordering assert on.
         with self._gen_lock:
             if self._gen.get(g, 0) != gen:
                 # destroy() completed while this miss was listing: the
                 # listing belongs to the dead incarnation.
                 return None
-            # setdefault, not assignment: if the tick thread archived a
+            # setdefault, not assignment: if another thread archived a
             # NEWER snapshot while this (possibly transport-thread) miss
-            # was listing the directory, its cache entry must win — a
-            # stale write-back here would pin an old/None value until
-            # the group's next checkpoint.
-            return self._newest.setdefault(g, snap)
+            # was listing the directory, its manifest must win — a stale
+            # write-back here would pin an old/empty view until the
+            # group's next checkpoint.
+            m = self._manifest.setdefault(g, snaps)
+            return m[-1] if m else None
 
     def list_snapshots(self, g: int) -> List[Snapshot]:
+        with self._gen_lock:
+            m = self._manifest.get(g)
+            if m is not None:
+                return list(m)
+            gen = self._gen.get(g, 0)
+        snaps = self._scan_dir(g)
+        with self._gen_lock:
+            if self._gen.get(g, 0) != gen:
+                return []
+            return list(self._manifest.setdefault(g, snaps))
+
+    def _scan_dir(self, g: int) -> List[Snapshot]:
+        """Cold read of a group directory (manifest warm-up only)."""
         d = self._gdir(g)
         out = []
         try:
@@ -156,14 +186,6 @@ class SnapshotArchive:
                                     int(m.group(1), 16), int(m.group(2), 16)))
         out.sort(key=lambda s: (s.term, s.index))
         return out
-
-    def _prune(self, g: int) -> None:
-        snaps = self.list_snapshots(g)
-        for s in snaps[:-self.retain]:
-            try:
-                os.unlink(s.path)
-            except OSError:
-                pass
 
     # -- remote installs -----------------------------------------------------
 
@@ -225,5 +247,5 @@ class SnapshotArchive:
         # that starts after the bump lists the (empty) new-incarnation
         # directory — caching that is correct.
         with self._gen_lock:
-            self._newest.pop(g, None)
+            self._manifest.pop(g, None)
             self._gen[g] = self._gen.get(g, 0) + 1

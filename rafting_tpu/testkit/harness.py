@@ -45,7 +45,9 @@ class LocalCluster:
                  maintain_factory: Optional[Callable[[], object]] = None,
                  store_factory: Optional[Callable[[int], object]] = None,
                  serializer_factory: Optional[Callable[[], object]] = None,
-                 transport: str = "loopback"):
+                 transport: str = "loopback",
+                 pipeline: Optional[bool] = None,
+                 wal_shards: Optional[int] = None):
         """``provider_factory(node_id)`` returns a MachineProvider; defaults
         to FileMachine per group under ``root/node<i>/machines`` (the
         reference's file-append oracle, cluster/cmd/FileMachine.java).
@@ -60,11 +62,15 @@ class LocalCluster:
         real localhost sockets per node, so the framing / sender-queue /
         reader-thread / accumulator plane is exercised under the same
         manual-tick control (the reference's system test runs real TCP,
-        test/resources/raft1.xml:3-7)."""
+        test/resources/raft1.xml:3-7).
+        ``pipeline`` / ``wal_shards``: forwarded to every RaftNode (see
+        RaftNode.__init__; None = the node's env-driven defaults)."""
         self.cfg = cfg
         self.root = root
         self.seed = seed
         self.transport = transport
+        self.pipeline = pipeline
+        self.wal_shards = wal_shards
         self.net = LoopbackNetwork(cfg.n_peers)
         self._ports = free_ports(cfg.n_peers) if transport == "tcp" else None
         self.provider_factory = provider_factory or (
@@ -110,7 +116,9 @@ class LocalCluster:
                       if self.maintain_factory else None),
             store=(self.store_factory(i) if self.store_factory else None),
             serializer=(self.serializer_factory()
-                        if self.serializer_factory else None))
+                        if self.serializer_factory else None),
+            pipeline=self.pipeline,
+            wal_shards=self.wal_shards)
         node.transport.start()
         self.nodes[i] = node
         return node

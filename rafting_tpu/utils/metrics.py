@@ -160,6 +160,15 @@ class Metrics:
         dt = max(time.monotonic() - self._t0, 1e-9)
         return {f"{k}_per_sec": v / dt for k, v in counters.items()}
 
+    def breakdown(self, prefix: str = "tick_stage_") -> Dict[str, dict]:
+        """Summaries of every histogram under ``prefix`` keyed by the bare
+        stage name — the per-stage tick breakdown (scan-wait, wal, fsync,
+        send, apply, maintain) the runtime observes each tick and the
+        durable bench reports per run."""
+        return {name[len(prefix):]: h.summary()
+                for name, h in dict(self._histograms).items()
+                if name.startswith(prefix)}
+
     def to_dict(self) -> dict:
         return {
             "uptime_s": time.monotonic() - self._t0,
