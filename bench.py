@@ -100,7 +100,7 @@ def child_run(n_groups: int, measure_ticks: int, warmup_ticks: int,
     # Leadership.java REPLICATE_LIMIT).
     # BENCH_TRACE=1: compile the flight recorder into the scan
     # (cfg.trace_depth event-ring slots per group, BENCH_TRACE_DEPTH
-    # overrides the default 8) — the recorder-overhead A/B: same load,
+    # overrides the default 16) — the recorder-overhead A/B: same load,
     # same schedule, commits/sec with the trace lanes vs without.
     trace_on = env_flag("BENCH_TRACE")
     cfg = EngineConfig(
@@ -114,7 +114,9 @@ def child_run(n_groups: int, measure_ticks: int, warmup_ticks: int,
         # (ops/quorum.py) instead of inline jnp — the A/B the TPU decision
         # needs is then one env var per run.
         use_pallas=env_flag("BENCH_USE_PALLAS"),
-        trace_depth=(int(os.environ.get("BENCH_TRACE_DEPTH", "8"))
+        # Default matches the engine's floor (>= 12 slots now that a tick
+        # can emit up to 11 events — membership added three kinds).
+        trace_depth=(int(os.environ.get("BENCH_TRACE_DEPTH", "16"))
                      if trace_on else 0),
     )
     # Group-axis tiling (groups are independent; run_cluster_ticks_blocked).
@@ -297,6 +299,176 @@ def child_run(n_groups: int, measure_ticks: int, warmup_ticks: int,
     return res
 
 
+def member_child(n_groups: int) -> dict:
+    """BENCH_MEMBER stage, in-process: (1) the masked-quorum commit
+    kernel A/B'd against the legacy fixed-majority baseline at P=3 —
+    asserting the membership-aware kernel stays within noise (>= 0.95x);
+    (2) reconfig walk-through throughput: every group walks the full
+    3 -> 3-disjoint rebalance (add learners {3,4,5} -> catch up ->
+    joint switch to {3,4,5} -> auto-leave) at P=6, reported as groups
+    reconfigured per second with zero committed-entry loss asserted."""
+    import faulthandler
+    faulthandler.enable()
+    timeout_s = float(os.environ.get("BENCH_CHILD_WATCHDOG", "240"))
+    faulthandler.dump_traceback_later(timeout_s, exit=False)
+
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from rafting_tpu import DeviceCluster, EngineConfig
+    from rafting_tpu.core.cluster import cluster_snapshot
+    from rafting_tpu.core.sim import run_cluster_ticks
+    from rafting_tpu.core.types import conf_new_of, conf_voters_of
+
+    dev = jax.devices()[0]
+    # ONE scan length everywhere: run_cluster_ticks compiles per static
+    # tick count, so warm-up must execute the exact program the measured
+    # window re-runs (a 32-tick warmup before a 64-tick measure times the
+    # 64-tick compile INSIDE the measurement).
+    CHUNK = 16
+
+    def scan_chunks(cfg, c, n_ticks, submit):
+        for _ in range(n_ticks // CHUNK):
+            c.states, c.inflight, c.last_info = run_cluster_ticks(
+                cfg, CHUNK, c.states, c.inflight, c.last_info, c.conn,
+                submit)
+
+    def commits_per_sec(cfg, reps=2) -> float:
+        c = DeviceCluster(cfg, seed=0)
+        submit = jnp.full((cfg.n_peers, cfg.n_groups), cfg.max_submit,
+                          jnp.int32)
+        scan_chunks(cfg, c, 32, submit)   # compile + elect + steady state
+        best = 0.0
+        for _ in range(reps):
+            start = int(np.asarray(c.states.commit).max(axis=0)
+                        .astype(np.int64).sum())
+            t0 = time.perf_counter()
+            scan_chunks(cfg, c, 64, submit)
+            end = int(np.asarray(c.states.commit).max(axis=0)
+                      .astype(np.int64).sum())
+            best = max(best, (end - start) / (time.perf_counter() - t0))
+        return best
+
+    # -- (1) masked vs fixed-majority commit kernel, P=3 ------------------
+    base_cfg = EngineConfig(
+        n_groups=n_groups, n_peers=3,
+        log_slots=int(os.environ.get("BENCH_LOG_SLOTS", "64")),
+        batch=int(os.environ.get("BENCH_BATCH", "8")),
+        max_submit=int(os.environ.get("BENCH_MAX_SUBMIT", "8")),
+        election_ticks=10, heartbeat_ticks=3, rpc_timeout_ticks=8,
+        pre_vote=True)
+    cps_fixed = commits_per_sec(
+        dataclasses.replace(base_cfg, quorum_fixed=True))
+    cps_masked = commits_per_sec(base_cfg)
+    ratio = cps_masked / max(cps_fixed, 1e-9)
+    assert ratio >= 0.95, \
+        (f"masked-quorum kernel regressed commit throughput beyond noise "
+         f"at P=3: {cps_masked:,.0f} vs fixed {cps_fixed:,.0f} "
+         f"({ratio:.3f}x)")
+
+    # -- (2) reconfig walk-through throughput, P=6 3->3-disjoint ----------
+    cfg6 = dataclasses.replace(base_cfg, n_peers=6)
+    c = DeviceCluster(cfg6, seed=0, n_voters=3)
+    submit = jnp.full((6, n_groups), cfg6.max_submit, jnp.int32)
+    scan_chunks(cfg6, c, 64, submit)   # compile + elect + steady state
+    pre_commit = cluster_snapshot(c.states)["commit"].max(axis=0).copy()
+    assert (pre_commit > 0).all(), "warm-up never committed"
+    target = 0b111000
+    new_nodes = (3, 4, 5)
+
+    def walk_done() -> bool:
+        w = np.asarray(c.last_info.conf_word)[new_nodes, :]
+        ok = ((conf_voters_of(w) == target) & (conf_new_of(w) == 0)).all()
+        roles = np.asarray(c.states.role)[new_nodes, :]
+        return bool(ok and ((roles == 3).sum(axis=0) == 1).all())
+
+    # The walk runs under LIGHT live traffic (1 command/group/tick): the
+    # self-driving scan policy compacts every tick, and at full offered
+    # load the floor outruns any learner snapshot install (the documented
+    # pursuit-never-converges regime, core/cluster.py auto_host_inbox) —
+    # real deployments gate compaction on checkpoint cadences instead.
+    submit_walk = jnp.ones((6, n_groups), jnp.int32)
+    scan_chunks(cfg6, c, CHUNK, submit_walk)   # compile the walk program
+    t0 = time.perf_counter()
+    c.request_membership(voters=0b000111, learners=target)   # learners in
+    scan_chunks(cfg6, c, 48, submit_walk)
+    c.request_membership(voters=target, learners=0)          # joint switch
+    chunks = 0
+    while not walk_done():
+        scan_chunks(cfg6, c, CHUNK, submit_walk)
+        chunks += 1
+        assert chunks < 64, "rebalance walk did not converge"
+    elapsed = time.perf_counter() - t0
+    # Zero committed-entry loss: the new set's commit frontier covers the
+    # pre-walk frontier and keeps advancing under the new voters.
+    snap = cluster_snapshot(c.states)
+    post = snap["commit"][new_nodes, :].max(axis=0)
+    assert (post >= pre_commit).all(), "committed entries lost in the walk"
+    scan_chunks(cfg6, c, CHUNK, submit)
+    post2 = cluster_snapshot(c.states)["commit"][new_nodes, :].max(axis=0)
+    assert (post2 > post).all(), "commits stalled after the walk"
+
+    faulthandler.cancel_dump_traceback_later()
+    return {
+        "scale": n_groups,
+        "platform": dev.platform,
+        "member_stage": True,
+        "walk_groups_per_sec": n_groups / elapsed,
+        "walk_elapsed_s": round(elapsed, 3),
+        "cps_masked": cps_masked,
+        "cps_fixed": cps_fixed,
+        "masked_vs_fixed": round(ratio, 4),
+    }
+
+
+def run_member_ladder(profile_unused: str = "") -> None:
+    """BENCH_MEMBER=1: the membership stage replaces the normal ladder —
+    reconfig walk-through throughput at 1k/32k/100k plus the
+    masked-vs-fixed commit A/B at P=3, one subprocess per scale."""
+    timeout_s = float(os.environ.get("BENCH_MEMBER_TIMEOUT", "420"))
+    any_ok = False
+    for g in (1_024, 32_768, 100_000):
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--member-child", str(g)]
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__)) + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=timeout_s, env=env)
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(f"[bench] member scale {g}: TIMEOUT\n")
+            continue
+        if r.returncode != 0:
+            tail = "\n".join(r.stderr.strip().splitlines()[-10:])
+            sys.stderr.write(f"[bench] member scale {g}: rc="
+                             f"{r.returncode}\n{tail}\n")
+            continue
+        try:
+            res = json.loads(r.stdout.strip().splitlines()[-1])
+        except (json.JSONDecodeError, IndexError):
+            continue
+        save_artifact(res, child_env=env, note="BENCH_MEMBER stage")
+        any_ok = True
+        emit({
+            "metric": f"membership rebalance walk-throughs/sec "
+                      f"@{g // 1000}k Raft groups (3->3-disjoint walk: "
+                      f"add-learner -> catch-up -> joint switch -> "
+                      f"auto-leave, P=6, {res['platform']}) "
+                      f"[masked-quorum commit kernel "
+                      f"{res['masked_vs_fixed']}x of fixed-majority @P=3]",
+            "value": round(res["walk_groups_per_sec"]),
+            "unit": "groups/sec",
+            "vs_baseline": res["masked_vs_fixed"],
+        })
+    if not any_ok:
+        emit({"metric": "membership rebalance stage (no scale survived)",
+              "value": 0, "unit": "groups/sec", "vs_baseline": 0.0})
+        sys.exit(1)
+
+
 def headline(res: dict, fallback: str = "", tuned: bool = False,
              extra_note: str = "") -> dict:
     plat = res["platform"]
@@ -451,6 +623,15 @@ def main() -> None:
         profile_dir = sys.argv[6] if len(sys.argv) > 6 else ""
         print(json.dumps(child_run(n_groups, ticks, warmup, platform,
                                    profile_dir)))
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--member-child":
+        print(json.dumps(member_child(int(sys.argv[2]))))
+        return
+    if env_flag("BENCH_MEMBER"):
+        # The membership stage replaces the ladder (like a pinned
+        # BENCH_READS run measures reads): reconfig walk-through
+        # throughput + the masked-vs-fixed commit kernel A/B.
+        run_member_ladder()
         return
 
     profile_dir = os.environ.get("BENCH_PROFILE_DIR", "")

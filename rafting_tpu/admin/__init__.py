@@ -8,9 +8,11 @@ from .administrator import (
     build_close_tx, build_open_tx,
 )
 from .kv import KVEngine, STM
+from .rebalance import Rebalancer, RebalanceError
 
 __all__ = [
     "Administrator", "AdminProvider", "LifecycleBus",
     "KVEngine", "STM", "build_open_tx", "build_close_tx",
     "NOT_FOUND", "NORMAL", "SLEEPING", "DESTROYED",
+    "Rebalancer", "RebalanceError",
 ]

@@ -54,7 +54,8 @@ class RaftFactory:
                                 node.template, on_slice, snapshot_provider,
                                 submit_handler=node.submit,
                                 result_encoder=node.serializer.encode_result,
-                                read_handler=node.read)
+                                read_handler=node.read,
+                                conf_node=node)
         return build
 
     def maintain(self, config: RaftConfig):

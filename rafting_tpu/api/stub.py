@@ -281,6 +281,87 @@ class RaftStub:
         return out
 
 
+    def change_membership(self, voters: int, learners: int = 0,
+                          timeout: Optional[float] = None) -> Future:
+        """Reconfigure this group to the TARGET config (§6 joint
+        consensus; voters/learners are peer-slot bitmasks).  Leader-local
+        when possible; with ``forward=True`` a non-leader stub relays the
+        op to the leader over the FWD_CONF channel, chasing NotLeader
+        hints like submit (bounded by forward_budget / max_redirects).
+        Resolves once the final config is active and committed."""
+        from ..transport.codec import CONF_OP_CHANGE
+
+        return self._membership_op(CONF_OP_CHANGE, int(voters),
+                                   int(learners), timeout,
+                                   lambda node, lane: node.change_membership(
+                                       lane, voters, learners))
+
+    def transfer_leadership(self, target: int,
+                            timeout: Optional[float] = None) -> Future:
+        """Hand this group's leadership to voter ``target`` (§3.10
+        TimeoutNow).  Forwarded to the current leader when this node is a
+        follower; resolves once the old leader relinquished after
+        TimeoutNow."""
+        from ..transport.codec import CONF_OP_TRANSFER
+
+        return self._membership_op(CONF_OP_TRANSFER, int(target), 0,
+                                   timeout,
+                                   lambda node, lane:
+                                   node.transfer_leadership(lane, target))
+
+    def _membership_op(self, op: int, a: int, b: int,
+                       budget: Optional[float], local_call) -> Future:
+        """Shared leader-resolution loop for membership ops: run locally
+        when leading, else relay over FWD_CONF — same refusal-chasing
+        contract as _forwarded, on the membership channel."""
+        import json as _json
+        import time as _time
+
+        if self._closed:
+            raise ObsoleteContextError(f"stub for {self.name!r} closed")
+        node = self._container._node
+        lane = self.lane
+        if node.is_leader(lane) or not self.forward:
+            return local_call(node, lane)
+        out: Future = Future()
+        total = self.forward_budget if budget is None else budget
+
+        def run():
+            overall = _time.monotonic() + total
+            retries = 0
+            try:
+                while True:
+                    left = max(0.05, overall - _time.monotonic())
+                    if node.is_leader(lane):
+                        fut = local_call(node, lane)
+                        out.set_result(fut.result(timeout=left))
+                        return
+                    hint = node.leader_hint(lane)
+                    if hint is not None and hint != node.node_id:
+                        ok, raw = node.transport.forward_conf(
+                            hint, lane, op, a, b, timeout=left)
+                        if ok:
+                            out.set_result(_json.loads(raw))
+                            return
+                        msg = raw.decode(errors="replace")
+                        kind = msg.split(":", 2)[1] if ":" in msg else ""
+                        if not (msg.startswith("REFUSED:")
+                                and kind in self._TRANSIENT_REFUSALS):
+                            raise RaftError(f"membership forward failed: "
+                                            f"{msg}")
+                    retries += 1
+                    if retries > self.max_redirects \
+                            or _time.monotonic() >= overall:
+                        raise NotLeaderError(lane, node.leader_hint(lane))
+                    _time.sleep(min(0.5, 0.05 * (2 ** min(retries, 4)))
+                                * random.uniform(0.5, 1.5))
+            except Exception as e:
+                if not out.done():
+                    out.set_exception(e)
+        threading.Thread(target=run, daemon=True,
+                         name=f"raft-conf-{self.name}").start()
+        return out
+
     def execute(self, command: Union[bytes, str],
                 timeout: Optional[float] = None) -> Any:
         """Blocking submit (reference RaftStub.execute,

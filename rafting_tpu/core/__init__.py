@@ -1,9 +1,13 @@
 from .types import (
     CANDIDATE, FOLLOWER, LEADER, NIL, PRE_CANDIDATE,
     EngineConfig, FaultSchedule, HostInbox, LogState, Messages, RaftState,
-    StepInfo, crash_restart, init_state,
+    StepInfo, boot_conf_word, conf_learners_of, conf_new_of, conf_pack,
+    conf_voters_of, crash_restart, init_state,
 )
-from .step import node_step, ring_term_at, ring_terms_batch, ring_write_batch
+from .step import (
+    dual_quorum, latest_conf, node_step, ring_term_at, ring_terms_batch,
+    ring_write_batch,
+)
 from .cluster import (
     DeviceCluster, auto_host_inbox, cluster_step, cluster_step_nemesis, route,
 )
@@ -15,4 +19,6 @@ __all__ = [
     "init_state", "node_step", "ring_term_at",
     "ring_terms_batch", "ring_write_batch", "DeviceCluster", "cluster_step",
     "route", "auto_host_inbox",
+    "boot_conf_word", "conf_pack", "conf_voters_of", "conf_new_of",
+    "conf_learners_of", "dual_quorum", "latest_conf",
 ]

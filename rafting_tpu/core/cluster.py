@@ -223,6 +223,7 @@ def auto_host_inbox(cfg: EngineConfig, states: RaftState, submit_n: jax.Array,
             snap_done=info.snap_req,
             snap_idx=info.snap_req_idx,
             snap_term=info.snap_req_term,
+            snap_conf=info.snap_req_conf,
             durable_tail=info.log_tail if durable_lag else None,
         )
     return jax.vmap(one)(states, submit_n, read_n, prev_info)
@@ -256,14 +257,15 @@ class DeviceCluster:
     """
 
     def __init__(self, cfg: EngineConfig, seed: int = 0,
-                 n_active: int | None = None):
+                 n_active: int | None = None, n_voters: int | None = None):
         self.cfg = cfg
         # Compaction policy for the self-driving inbox (see
         # auto_host_inbox): True = every tick, int K = every K ticks,
         # False = never.  Set a cadence when simulating laggard catch-up.
         self.compact = True
         N = cfg.n_peers
-        states = [init_state(cfg, i, seed=seed, n_active=n_active)
+        states = [init_state(cfg, i, seed=seed, n_active=n_active,
+                             n_voters=n_voters)
                   for i in range(N)]
         self.states: RaftState = jax.tree.map(
             lambda *xs: jnp.stack(xs), *states)
@@ -336,6 +338,65 @@ class DeviceCluster:
     def run(self, n_ticks: int, submit_n=None) -> None:
         for _ in range(n_ticks):
             self.tick(submit_n)
+
+    # -- membership ---------------------------------------------------------
+    def request_membership(self, voters: int, learners: int = 0,
+                           groups=None, submit_n=None) -> StepInfo:
+        """One tick with a membership-change request offered to EVERY node
+        for the selected groups (only the leader's intake takes it; §6,
+        core/step.py phase 8c).  ``voters``/``learners`` are peer
+        bitmasks; ``groups`` (None = all) selects lanes.  The request is
+        a single-tick offer — drive further ticks until
+        ``StepInfo.conf_pending`` clears and the active ``conf_word``
+        matches (the joint walk's leave entry auto-appends)."""
+        import jax.numpy as jnp
+
+        N, G = self.cfg.n_peers, self.cfg.n_groups
+        sel = np.zeros(G, bool)
+        sel[np.asarray(list(range(G)) if groups is None else groups)] = True
+        hv = jnp.asarray(np.where(sel, voters, 0).astype(np.int32))
+        hl = jnp.asarray(np.where(sel, learners, 0).astype(np.int32))
+        return self._tick_with(conf_voters=hv, conf_learners=hl,
+                               submit_n=submit_n)
+
+    def request_transfer(self, target, groups=None) -> StepInfo:
+        """One tick with a leadership-transfer request (TimeoutNow walk,
+        core/step.py phase 7b/9) offered to every node for the selected
+        groups.  ``target`` is a peer id (or [G] vector)."""
+        import jax.numpy as jnp
+
+        G = self.cfg.n_groups
+        sel = np.zeros(G, bool)
+        sel[np.asarray(list(range(G)) if groups is None else groups)] = True
+        tgt = np.broadcast_to(np.asarray(target, np.int32), (G,))
+        tgt = np.where(sel, tgt, -1).astype(np.int32)
+        return self._tick_with(xfer_target=jnp.asarray(tgt))
+
+    def _tick_with(self, submit_n=None, **host_lanes) -> StepInfo:
+        """Tick once with extra per-group HostInbox lanes broadcast to
+        every node on top of the self-driving policy."""
+        import jax.numpy as jnp
+
+        N, G = self.cfg.n_peers, self.cfg.n_groups
+        sub = jnp.zeros((N, G), jnp.int32) if submit_n is None else \
+            jnp.broadcast_to(jnp.asarray(submit_n, jnp.int32), (N, G))
+        host = auto_host_inbox(self.cfg, self.states, sub, self.compact,
+                               self.last_info)
+        host = host.replace(**{
+            k: jnp.broadcast_to(v, (N,) + v.shape) for k, v in
+            host_lanes.items()})
+        return self.tick(host=host)
+
+    def membership(self, group: int, node: int = 0) -> dict:
+        """Decoded active config of one group as one node sees it (the
+        state's conf_word cache; configs converge with the log)."""
+        from .types import conf_learners_of, conf_new_of, conf_voters_of
+
+        w = int(self.states.conf_word[node, group])
+        return {"voters": int(conf_voters_of(w)),
+                "voters_new": int(conf_new_of(w)),
+                "learners": int(conf_learners_of(w)),
+                "joint": bool(conf_new_of(w))}
 
     # -- inspection ---------------------------------------------------------
     def snapshot(self) -> dict:

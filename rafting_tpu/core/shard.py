@@ -44,8 +44,9 @@ def state_pspecs(trace: bool = False) -> RaftState:
     kw = {f.name: _NODE_GROUP for f in dataclasses.fields(RaftState)}
     for name in _STATE_NODE_ONLY:
         kw[name] = _NODE
-    kw["log"] = LogState(term=_NODE_GROUP, base=_NODE_GROUP,
-                         base_term=_NODE_GROUP, last=_NODE_GROUP)
+    kw["log"] = LogState(term=_NODE_GROUP, conf=_NODE_GROUP,
+                         base=_NODE_GROUP, base_term=_NODE_GROUP,
+                         base_conf=_NODE_GROUP, last=_NODE_GROUP)
     kw["trace"] = TraceState(
         tick=_NODE_GROUP, kind=_NODE_GROUP, term=_NODE_GROUP,
         aux=_NODE_GROUP, n=_NODE_GROUP) if trace else None

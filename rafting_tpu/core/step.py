@@ -33,12 +33,20 @@ Phase order within a tick (messages produced in tick t are delivered in t+1):
   6. AppendEntries resps — leader match/next bookkeeping
   6b. read evidence      — same-term ack receipts/echoes feed the barrier
   7. timers              — election timeout → PreVote round / new election
+                           (voters only; TimeoutNow → immediate candidacy)
+  7b. transfer intake    — leadership-transfer requests latch/abort; a
+                           pending transfer fences submissions
   8. submissions         — leader accepts client commands into the log
   8b. read plane         — stamp ReadIndex batches, release on quorum
                            barrier (lease fast path: same-tick evidence)
+  8c. membership         — config-change intake (§6 joint consensus) +
+                           automatic C_new leave once C_old,new commits
   9. replication         — leader builds AppendEntries / snapshot offers
-                           (+ barrier-kicked heartbeats, tick-stamped)
- 10. commit advance      — quorum median over matchIndex, own-term rule
+                           over MEMBER lanes (+ barrier-kicked heartbeats,
+                           tick-stamped); TimeoutNow to a caught-up target
+ 10. commit advance      — masked-quorum order statistic over matchIndex
+                           (joint: both voter sets), own-term rule; a
+                           removed leader resigns once C_new commits
  11. flight recorder     — branchless per-group event-ring writes of the
                            tick's phase-boundary events (cfg.trace_depth;
                            compiled away entirely when 0)
@@ -56,6 +64,7 @@ import jax.numpy as jnp
 from .types import (
     CANDIDATE, FOLLOWER, LEADER, NIL, PRE_CANDIDATE, I32,
     EngineConfig, HostInbox, LogState, Messages, RaftState, StepInfo,
+    conf_learners_of, conf_new_of, conf_pack, conf_voters_of,
 )
 
 Array = jax.Array
@@ -71,6 +80,7 @@ DEBUG_CODES = {
     6: "commit regressed",
     7: "pipeline head behind ack base",
     8: "read FIFO length out of range",
+    9: "active config has no voters",
 }
 
 
@@ -130,6 +140,65 @@ def ring_write_batch(log_term: Array, idx: Array, vals: Array, mask: Array) -> A
     return log_term.at[rows, slot].set(vals, mode="drop")
 
 
+def ring_conf_batch(log: LogState, idx: Array) -> Array:
+    """Packed config words for a [G, K] index matrix.
+
+    0 outside the live window (compacted entries' configs are folded into
+    ``base_conf``; absent entries carry nothing) — the AE build gathers
+    entry config words with exactly these semantics, so followers adopt
+    configs with the same window rules as terms."""
+    L = log.conf.shape[1]
+    slot = jnp.remainder(idx, L)
+    w = jnp.take_along_axis(log.conf, slot, axis=1)
+    live = (idx > log.base[:, None]) & (idx <= log.last[:, None])
+    return jnp.where(live, w, jnp.asarray(0, I32))
+
+
+def latest_conf(log: LogState, upto: Array) -> Tuple[Array, Array]:
+    """The active configuration per group: ``(conf_idx, conf_word)`` of the
+    latest config entry in ``(base, min(upto, last)]``, falling back to
+    ``(0, base_conf)`` when none is live.
+
+    The §6 apply-on-append rule AND its truncation rollback in one
+    derivation: a node uses the newest config present in its log whether
+    committed or not, and a conflict truncation that removes an
+    uncommitted config entry automatically reverts to the previous one —
+    no separate rollback state to maintain.  One [G, L] sweep, the same
+    shape of work as the replication gather (``ring_terms_batch`` over
+    [G, P*B])."""
+    G, L = log.conf.shape
+    j = jnp.arange(L, dtype=I32)[None, :]
+    # The unique index congruent to slot j (mod L) within (last-L, last].
+    idx = log.last[:, None] - jnp.remainder(log.last[:, None] - j, L)
+    isc = (idx > log.base[:, None]) & (idx <= upto[:, None]) \
+        & (log.conf != 0)
+    cidx = jnp.where(isc, idx, 0).max(axis=1)
+    w = jnp.take_along_axis(log.conf, jnp.remainder(cidx, L)[:, None],
+                            axis=1)[:, 0]
+    has = cidx > 0
+    return (jnp.where(has, cidx, 0),
+            jnp.where(has, w, log.base_conf))
+
+
+def mask_bits(mask: Array, P: int) -> Array:
+    """Expand [G] peer bitmasks into a [G, P] boolean matrix."""
+    return ((mask[:, None] >> jnp.arange(P, dtype=I32)[None, :]) & 1) > 0
+
+
+def dual_quorum(flags: Array, voters: Array, voters_new: Array) -> Array:
+    """Popcount-over-masked-lanes quorum: do ``flags`` [G, P] cover a
+    majority of ``voters`` — and, when joint (``voters_new`` nonzero), a
+    majority of ``voters_new`` TOO (Raft §6: joint decisions need both)?
+    Used by vote tallies, PreVote tallies and the leader readiness gate;
+    the commit quorum is the order-statistic analog in ops/quorum.py."""
+    P = flags.shape[1]
+    vb = mask_bits(voters, P)
+    nb = mask_bits(voters_new, P)
+    ok_v = (flags & vb).sum(axis=1) >= vb.sum(axis=1) // 2 + 1
+    ok_n = (flags & nb).sum(axis=1) >= nb.sum(axis=1) // 2 + 1
+    return ok_v & ((voters_new == 0) | ok_n)
+
+
 def _pick_peer(flag_pg: Array) -> Tuple[Array, Array]:
     """Select the lowest-indexed peer whose flag is set, per group.
 
@@ -184,6 +253,18 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
 
     old_term, old_voted, old_last = term, voted, log.last
 
+    # ---- 0. membership view C0 (tick-start) -------------------------------
+    # The active config is a function of the log (§6 apply-on-append +
+    # truncation rollback, see latest_conf); the state carries it as the
+    # conf_idx/conf_word cache, re-derived at the end of every tick's log
+    # mutations — so C0 is two state reads, not a [G, L] sweep.  C0
+    # anchors the vote/prevote tallies (phase 3): a tally must count
+    # against the config of the log the candidacy was launched from — the
+    # same log whose position the vote requests carried.
+    cidx0, w0 = s.conf_idx, s.conf_word
+    voters0 = conf_voters_of(w0)
+    vnew0 = conf_new_of(w0)
+
     # ---- 1. term sync: adopt the highest real term seen this tick ---------
     # (the universal Raft rule; reference applies it per-RPC via
     # switchTo(Follower, term): Follower.java:45-47, Candidate.java:28-41,
@@ -199,6 +280,7 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         masked(inbox.rvr_valid, inbox.rvr_term),
         masked(inbox.is_valid, inbox.is_term),
         masked(inbox.isr_valid, inbox.isr_term),
+        masked(inbox.tn_valid, inbox.tn_term),
     ]).max(axis=0)                                           # [G]
     stepdown = active & (mt > term)
     term = jnp.where(stepdown, mt, term)
@@ -257,8 +339,22 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
             (role == CANDIDATE)[None, :] & (inbox.rvr_term == term[None, :]))
     votes = votes | g_rv.T
 
-    maj = jnp.asarray(cfg.majority, I32)
-    pv_win = (role == PRE_CANDIDATE) & (prevotes.sum(axis=1) >= maj)
+    # Tallies are popcount-over-masked-lanes quorums against C0 (§6: a
+    # joint config needs a majority in BOTH voter sets; learners and
+    # removed slots never count, though their grants are harmless).  The
+    # PreVote and RequestVote tallies share one set of masks/thresholds
+    # (both count against C0).
+    vb0 = mask_bits(voters0, P)
+    nb0 = mask_bits(vnew0, P)
+    maj_v0 = vb0.sum(axis=1) // 2 + 1
+    maj_n0 = nb0.sum(axis=1) // 2 + 1
+    not_joint0 = vnew0 == 0
+
+    def tally0(flags):
+        return ((flags & vb0).sum(axis=1) >= maj_v0) \
+            & (not_joint0 | ((flags & nb0).sum(axis=1) >= maj_n0))
+
+    pv_win = (role == PRE_CANDIDATE) & tally0(prevotes)
     # PreVote majority -> real candidacy at term+1 (reference
     # Follower.prepareElection:264-267 -> trySwitchTo(Candidate, term+1)).
     become_cand_pv = pv_win
@@ -269,7 +365,7 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     votes = jnp.where(become_cand_pv[:, None], self_hot, votes)
     elect_dl = jnp.where(become_cand_pv, now + rand_to, elect_dl)
 
-    vote_win = (role == CANDIDATE) & (votes.sum(axis=1) >= maj)
+    vote_win = (role == CANDIDATE) & tally0(votes)
     # Candidate majority -> Leader (reference Candidate.java:128-131 ->
     # Leader ctor + prepareReplication, Leader.java:25-50): reset the
     # replication matrix, health stats and heartbeat immediately.
@@ -304,9 +400,14 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     noop_ok = vote_win & (log.last - log.base < L)
     noop_idx = jnp.where(noop_ok, log.last + 1, 0)
     noop_term = jnp.where(noop_ok, term, 0)
+    # Every term-ring write clears/overwrites the conf-ring slot too: a
+    # reused ring slot must never leak a dead entry's config word into
+    # the latest_conf derivation.
     log = log.replace(
         term=ring_write_batch(log.term, (log.last + 1)[:, None],
                               term[:, None], noop_ok[:, None]),
+        conf=ring_write_batch(log.conf, (log.last + 1)[:, None],
+                              jnp.zeros((G, 1), I32), noop_ok[:, None]),
         last=log.last + noop_ok.astype(I32))
 
     # ---- 4. AppendEntries requests ----------------------------------------
@@ -331,6 +432,7 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     n_e = _gather_peer(inbox.ae_n, ae_peer)
     lc = _gather_peer(inbox.ae_commit, ae_peer)
     ents = _gather_peer(inbox.ae_ents, ae_peer)                  # [G, B]
+    cents = _gather_peer(inbox.ae_cents, ae_peer)                # [G, B]
     # Bounded-window partial accept: the live window (base, last] must never
     # exceed the ring capacity L, or new entries would alias committed slots.
     # A follower whose compaction floor lags the leader's clamps the batch to
@@ -354,6 +456,10 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     conflict = (acc[:, None] & in_n & exists & (cur != ents)).any(axis=1)
     wmask = acc[:, None] & in_n & (idxs > log.base[:, None])
     new_ring = ring_write_batch(log.term, idxs, ents, wmask)
+    # Config adoption rides the same write mask: a follower appending a
+    # config entry USES its config immediately (§6 apply-on-append via
+    # the post-phase latest_conf derivation).
+    new_cring = ring_write_batch(log.conf, idxs, cents, wmask)
     tail = prev_i + n_e
     # Conflict => truncate-then-append == overwrite + last = prev+n;
     # no conflict => never shrink (stale/duplicate RPC; reference
@@ -365,7 +471,7 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     wrote = acc & (n_e > 0) & ((new_last != log.last) | conflict)
     app_from = jnp.where(wrote, prev_i + 1, jnp.zeros((G,), I32))
     app_to = jnp.where(wrote, new_last, jnp.zeros((G,), I32))
-    log = log.replace(term=new_ring, last=new_last)
+    log = log.replace(term=new_ring, conf=new_cring, last=new_last)
     # Passive commit (reference Follower.java:76-82), bounded by the
     # *verified* prefix prev+n — not our log tail, which may still hold an
     # unverified divergent suffix from a deposed leader (Raft fig. 2:
@@ -412,6 +518,7 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     elect_dl = jnp.where(is_any, now + rand_to, elect_dl)
     off_idx = _gather_peer(inbox.is_idx, is_peer)
     off_term = _gather_peer(inbox.is_last_term, is_peer)
+    off_conf = _gather_peer(inbox.is_conf, is_peer)
     # Success only once the milestone is covered: either our snapshot floor
     # already includes it, or we hold a matching entry at that index.  While
     # the bulk download is in flight we answer failure so the leader keeps
@@ -425,6 +532,7 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     snap_from = jnp.where(useful, is_peer, 0)
     snap_idx_o = jnp.where(useful, off_idx, 0)
     snap_term_o = jnp.where(useful, off_term, 0)
+    snap_conf_o = jnp.where(useful, off_conf, 0)
     is_sel_snap = (peer_ids[:, None] == is_peer[None, :]) & is_t_ok
     out_isr_valid = is_v
     out_isr_term = jnp.broadcast_to(term[None, :], (P, G))
@@ -444,6 +552,10 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     log = log.replace(
         base=jnp.where(sd, host.snap_idx, log.base),
         base_term=jnp.where(sd, host.snap_term, log.base_term),
+        # The installed milestone's config becomes the derivation floor
+        # (0 from a legacy host = keep the current base_conf).
+        base_conf=jnp.where(sd & (host.snap_conf != 0), host.snap_conf,
+                            log.base_conf),
         last=jnp.where(sd, jnp.where(tail_matches, log.last, host.snap_idx),
                        log.last),
     )
@@ -452,12 +564,37 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     # Compaction grant from host (snapshot taken at compact_to): raise floor,
     # but never past commit (reference compactLog gates on the snapshot
     # milestone, RaftRoutine.java:365-400).  The milestone term is read from
-    # the ring *before* the floor moves.
+    # the ring *before* the floor moves — and so is the milestone CONFIG
+    # (the latest config entry at/under the new floor folds into
+    # base_conf before its ring slot leaves the live window).
     ct = jnp.minimum(host.compact_to, commit)
     do_c = active & (ct > log.base)
     ct_term = ring_term_at(log, ct)
+    # ONE [G, L] conf sweep serves both consumers: the milestone config
+    # (latest conf entry at/under the new floor, folded into base_conf)
+    # and the post-compaction active view C1 — what the timers (campaign
+    # eligibility), transfer intake and config-entry intake below act
+    # on.  (C0 is the state cache; this is the tick's only sweep.)
+    jL = jnp.arange(L, dtype=I32)[None, :]
+    sw_idx = log.last[:, None] - jnp.remainder(log.last[:, None] - jL, L)
+    sw_isc = (sw_idx > log.base[:, None]) & (log.conf != 0)
+    cidx_all = jnp.where(sw_isc, sw_idx, 0).max(axis=1)
+    w_all = jnp.take_along_axis(
+        log.conf, jnp.remainder(cidx_all, L)[:, None], axis=1)[:, 0]
+    cidx_ct = jnp.where(sw_isc & (sw_idx <= ct[:, None]), sw_idx, 0) \
+        .max(axis=1)
+    w_ct = jnp.take_along_axis(
+        log.conf, jnp.remainder(cidx_ct, L)[:, None], axis=1)[:, 0]
+    ct_conf = jnp.where(cidx_ct > 0, w_ct, log.base_conf)
     log = log.replace(base=jnp.where(do_c, ct, log.base),
-                      base_term=jnp.where(do_c, ct_term, log.base_term))
+                      base_term=jnp.where(do_c, ct_term, log.base_term),
+                      base_conf=jnp.where(do_c, ct_conf, log.base_conf))
+    live1 = cidx_all > log.base          # post-move floor
+    cidx1 = jnp.where(live1, cidx_all, 0)
+    w1 = jnp.where(live1, w_all, log.base_conf)
+    voters1 = conf_voters_of(w1)
+    vnew1 = conf_new_of(w1)
+    lrn1 = conf_learners_of(w1)
 
     # ---- 6. AppendEntries responses (leader bookkeeping) -------------------
     # (reference Leader.java:224-243 + Leadership.State.updateIndex:75-114.)
@@ -566,13 +703,28 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     # (reference RaftRoutine.electionTimeout:65-77 -> Follower.onTimeout:
     # 156-168: PreVote round if enabled, else direct candidacy; candidate
     # timeout restarts the election at term+1, Candidate.onTimeout:82-88.)
-    expired = active & (now >= elect_dl) & (role != LEADER)
+    # Only VOTERS campaign: learners and removed slots replicate but never
+    # start elections (§6 — a server not in the newest config of its own
+    # log stays quiet; it still grants votes and accepts AEs).
+    voter_self = (jnp.right_shift(voters1 | vnew1, me) & 1) > 0
+    expired = active & (now >= elect_dl) & (role != LEADER) & voter_self
     if cfg.pre_vote:
         start_pre = expired & ((role == FOLLOWER) | (role == PRE_CANDIDATE))
         timer_cand = expired & (role == CANDIDATE)
     else:
         start_pre = jnp.zeros((G,), jnp.bool_)
         timer_cand = expired
+    # TimeoutNow (§3.10 leadership transfer): a caught-up voter told to
+    # campaign does so IMMEDIATELY — no PreVote round, no waiting out the
+    # election timer (the whole point: the old leader is alive and its
+    # heartbeats would defeat PreVote's leader-stickiness check).  The
+    # term check fences stale/duplicate copies: once the target bumps to
+    # term+1, re-sent TimeoutNows at the old term are ignored.
+    tn_cand = ((inbox.tn_valid & active[None, :] & not_me_col
+                & (inbox.tn_term == term[None, :])).any(axis=0)
+               & voter_self & (role != LEADER))
+    start_pre = start_pre & ~tn_cand
+    timer_cand = timer_cand | tn_cand
     term = jnp.where(timer_cand, term + 1, term)
     voted = jnp.where(timer_cand, me, voted)
     role = jnp.where(timer_cand, CANDIDATE, jnp.where(start_pre, PRE_CANDIDATE, role))
@@ -584,12 +736,37 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     became_cand = become_cand_pv | timer_cand
     last_term_v = ring_term_at(log, log.last)
 
+    # ---- 7b. leadership-transfer intake/abort (§3.10) ---------------------
+    # A pending transfer lives only within one continuous leadership at
+    # one term and at most one election timeout long; anything else —
+    # step-down, term bump, the deadline — aborts it (the host fails the
+    # caller's future; the transfer may still have succeeded, which the
+    # caller observes via the leader hint, same contract as a submit
+    # abort).  While pending, client submissions and config changes are
+    # FENCED so the target's catch-up condition (match == last) is a
+    # stable target.
+    pend0 = s.xfer_to != NIL
+    keep_x = (pend0 & active & (role == LEADER) & (term == s.term)
+              & (now < s.xfer_dl))
+    xfer_abort = pend0 & ~keep_x
+    xfer_to = jnp.where(keep_x, s.xfer_to, NIL)
+    xfer_dl = jnp.where(keep_x, s.xfer_dl, 0)
+    tgt = host.xfer_target
+    tgt_voter = (jnp.right_shift(voters1 | vnew1,
+                                 jnp.clip(tgt, 0, P - 1)) & 1) > 0
+    take_x = (active & (role == LEADER) & (xfer_to == NIL)
+              & (tgt >= 0) & (tgt < P) & (tgt != me) & tgt_voter)
+    xfer_to = jnp.where(take_x, tgt, xfer_to)
+    xfer_dl = jnp.where(take_x, now + cfg.election_ticks, xfer_dl)
+    fenced = xfer_to != NIL
+
     # ---- 8. client submissions --------------------------------------------
     # (reference RaftStub.submit -> Leader.acceptCommand -> log.newEntry,
     # RaftStub.java:65-74, Leader.java:128-140, RocksLog.java:82-89.)
-    # Capacity gate: the ring must keep (last - base) <= L.
+    # Capacity gate: the ring must keep (last - base) <= L.  A pending
+    # leadership transfer fences intake (7b).
     free = L - (log.last - log.base)
-    n_acc = jnp.where(active & (role == LEADER),
+    n_acc = jnp.where(active & (role == LEADER) & ~fenced,
                       jnp.clip(host.submit_n, 0, jnp.minimum(free, S)), 0)
     sub_start = log.last + 1
     scol = jnp.arange(S, dtype=I32)[None, :]
@@ -597,7 +774,9 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     smask = scol < n_acc[:, None]
     new_ring = ring_write_batch(log.term, sidx,
                                 jnp.broadcast_to(term[:, None], (G, S)), smask)
-    log = log.replace(term=new_ring, last=log.last + n_acc)
+    new_cring = ring_write_batch(log.conf, sidx, jnp.zeros((G, S), I32),
+                                 smask)
+    log = log.replace(term=new_ring, conf=new_cring, last=log.last + n_acc)
     app_from = jnp.where((n_acc > 0) & (app_from == 0), sub_start, app_from)
     app_to = jnp.where(n_acc > 0, log.last, app_to)
 
@@ -641,7 +820,7 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     # trips — the lease fast path IS the general rule at its freshness
     # limit.  Strict mode can only release on a later tick's echo.
     n_rel, n_served = read_barrier_release(
-        maj, read_evid, rq_stamp, rq_head, rq_len, rq_n)
+        voters1, vnew1, me, read_evid, rq_stamp, rq_head, rq_len, rq_n)
     rq_head = jnp.remainder(rq_head + n_rel, K)
     rq_len = rq_len - n_rel
     read_lease_hit = read_acc & (n_rel > 0) & (rq_len == 0)
@@ -650,11 +829,64 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     # trip, not heartbeat_ticks + one round trip.
     read_kick = read_acc & (rq_len > 0)
 
+    # ---- 8c. membership-change intake + automatic joint leave (§6) --------
+    # A change request (HostInbox.conf_voters/conf_learners, the TARGET
+    # config) becomes ONE log entry: a joint C_old,new entry when the
+    # voter set moves, a simple entry when only learners change.  One
+    # change in flight per group: intake is fenced while the latest
+    # config entry is uncommitted, while joint, and while a leadership
+    # transfer is pending.  When the joint entry commits, the C_new leave
+    # entry is appended AUTOMATICALLY — the leader walks §6's two-entry
+    # protocol without host round-trips.  Config entries take effect on
+    # append (the next latest_conf derivation sees them): the leader
+    # counts the very commit that seals a joint entry under BOTH sets.
+    full_bits = jnp.asarray((1 << P) - 1, I32)
+    hv = host.conf_voters & full_bits
+    hl = host.conf_learners & full_bits & ~hv
+    joint1 = vnew1 != 0
+    pending1 = cidx1 > commit
+    space = log.last - log.base < L
+    may_append = active & (role == LEADER) & ~pending1 & space
+    # One pack covers both request kinds: a learner-only change (target
+    # voters == current) packs voters_new = 0 (simple entry).
+    enter_word = conf_pack(voters1, jnp.where(hv == voters1, 0, hv), hl)
+    want_enter = (may_append & ~joint1 & ~fenced & (hv != 0)
+                  & (enter_word != w1))
+    want_leave = may_append & joint1
+    leave_word = conf_pack(vnew1, 0, lrn1)
+    conf_app = want_enter | want_leave
+    app_word = jnp.where(want_leave, leave_word, enter_word)
+    nidx = log.last + 1
+    log = log.replace(
+        term=ring_write_batch(log.term, nidx[:, None], term[:, None],
+                              conf_app[:, None]),
+        conf=ring_write_batch(log.conf, nidx[:, None], app_word[:, None],
+                              conf_app[:, None]),
+        last=log.last + conf_app.astype(I32))
+    conf_app_idx = jnp.where(conf_app, nidx, 0)
+    conf_app_term = jnp.where(conf_app, term, 0)
+    conf_app_word = jnp.where(conf_app, app_word, 0)
+    app_from = jnp.where(conf_app & (app_from == 0), nidx, app_from)
+    app_to = jnp.where(conf_app, log.last, app_to)
+
+    # Membership view C2: the end-of-tick active config — replication
+    # fan-out, readiness, vote-solicitation targets and the commit quorum
+    # all run against it.
+    cidx2 = jnp.where(conf_app, nidx, cidx1)
+    w2 = jnp.where(conf_app, app_word, w1)
+    voters2 = conf_voters_of(w2)
+    vnew2 = conf_new_of(w2)
+    lrn2 = conf_learners_of(w2)
+    member2 = mask_bits(voters2 | vnew2 | lrn2, P)              # [G, P]
+
     # ---- 9. replication fan-out -------------------------------------------
     # (reference Leader.replicateLog:142-245 — the hot loop, now a dense
     # (group x peer) batch build straight from the HBM ring, pipelined up to
     # `inflight_limit` un-acked batches per peer, Leadership.java:10-11.)
-    lead_peer = (active & (role == LEADER))[:, None] & ~self_hot
+    # Fan-out only to MEMBER slots (voters, incoming voters, learners):
+    # removed/never-added slots get no AEs, no heartbeats, no snapshot
+    # offers — the membership masks gate the replication plane itself.
+    lead_peer = (active & (role == LEADER))[:, None] & ~self_hot & member2
     # RPC timeout: the window has been un-acked too long.  Failure evidence
     # for the health stats (reference statFailure on unreachable,
     # Leadership.java:65-73) + window reset so replication restarts from the
@@ -706,6 +938,7 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     # One fused gather for all peers' batches: [G, P*B] -> [P, G, B].
     flat_idx = (send_next[:, :, None] + col[None, :, :]).reshape(G, P * B)
     ents_all = ring_terms_batch(log, flat_idx).reshape(G, P, B)
+    cents_all = ring_conf_batch(log, flat_idx).reshape(G, P, B)
     prev_terms = ring_terms_batch(log, prev).T                   # [P, G]
     out_ae_valid = send_ae.T
     out_ae_term = jnp.broadcast_to(term[None, :], (P, G))
@@ -714,6 +947,7 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     out_ae_commit = jnp.broadcast_to(commit[None, :], (P, G))
     out_ae_n = n_send.T
     out_ae_ents = jnp.swapaxes(ents_all, 0, 1)                   # [P, G, B]
+    out_ae_cents = jnp.swapaxes(cents_all, 0, 1)                 # [P, G, B]
     out_ae_occ = hb_occupy.T
     # Send tick, echoed back as aer_tick (read-barrier evidence, 6b).
     out_ae_tick = jnp.broadcast_to(now, (P, G)).astype(I32)
@@ -730,6 +964,9 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     out_is_idx = jnp.broadcast_to(log.base[None, :], (P, G))
     out_is_last_term = jnp.broadcast_to(log.base_term[None, :], (P, G))
     out_is_probe = (send_is & ~send_is_win).T
+    # The offered milestone's config rides the offer: it becomes the
+    # installer's base_conf (via the host snap_conf round trip).
+    out_is_conf = jnp.broadcast_to(log.base_conf[None, :], (P, G))
     # Window accounting: data batches and the first snapshot offer occupy
     # data slots; in-window heartbeats occupy heartbeat slots; window-full
     # heartbeats and snapshot re-offers are slot-exempt (see above).  Any
@@ -752,14 +989,32 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     if cfg.recovery_ticks > 0:
         healthy = healthy & ((fail_at == 0) |
                              (now - fail_at >= cfg.recovery_ticks))
-    ready = (active & (role == LEADER) &
-             (1 + (healthy & lead_peer).sum(axis=1) >= maj))
+    # Readiness is a masked quorum over the ACTIVE config (joint: both
+    # sets); self counts iff self is a voter.  A pending leadership
+    # transfer reports not-ready — intake is fenced anyway, and the
+    # host's refusal gate should say so before the queue does.
+    ready = (active & (role == LEADER) & ~fenced &
+             dual_quorum((healthy & lead_peer) | self_hot, voters2, vnew2))
+
+    # TimeoutNow dispatch (7b intake): once the target's match covers our
+    # whole log, tell it to campaign.  Re-sent every tick while the
+    # condition holds — duplicates are fenced by the receiver's term
+    # check, and loss costs one tick, not the transfer.
+    tgt_match = jnp.take_along_axis(
+        match_idx, jnp.clip(xfer_to, 0, P - 1)[:, None], axis=1)[:, 0]
+    xfer_fire = (active & (role == LEADER) & (xfer_to != NIL)
+                 & (tgt_match >= log.last))
+    out_tn_valid = (peer_ids[:, None] == xfer_to[None, :]) & xfer_fire[None, :]
+    out_tn_term = jnp.broadcast_to(term[None, :], (P, G))
 
     # Election broadcasts (PreVote at speculative term+1 carrying our log
     # position, reference Follower.prepareElection:223-279; RequestVote at
     # the new term, Candidate.startElection:90-143).
     bcast = (became_cand | start_pre) & active
-    out_rv_valid = bcast[None, :] & not_me_col
+    # Solicit only VOTER slots (both sets while joint): learner grants
+    # would never count, so they are not asked.
+    out_rv_valid = bcast[None, :] & not_me_col \
+        & mask_bits(voters2 | vnew2, P).T
     out_rv_term = jnp.broadcast_to(
         jnp.where(start_pre, term + 1, term)[None, :], (P, G))
     out_rv_last_idx = jnp.broadcast_to(log.last[None, :], (P, G))
@@ -786,8 +1041,19 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         else jnp.minimum(log.last, host.durable_tail)
     match_full = jnp.where(self_hot, self_match[:, None], match_idx)
     commit = quorum_commit(cfg, match_full, log, commit, own_from,
-                           active & (role == LEADER))
+                           active & (role == LEADER), voters2, vnew2)
     match_idx = match_full
+
+    # §6 epilogue: a leader whose committed SIMPLE config no longer
+    # includes it steps down (it managed the cluster through the joint
+    # phase and just committed the C_new that removes it — this tick's
+    # AEs already carry that commit to the survivors).
+    resigned = (active & (role == LEADER) & (vnew2 == 0)
+                & (cidx2 <= commit)
+                & ((jnp.right_shift(voters2, me) & 1) == 0))
+    role = jnp.where(resigned, FOLLOWER, role)
+    leader_id = jnp.where(resigned, NIL, leader_id)
+    elect_dl = jnp.where(resigned, now + rand_to, elect_dl)
 
     # ---- flight recorder ---------------------------------------------------
     # Branchless per-group event-ring writes (cfg.trace_depth; zero cost
@@ -801,16 +1067,19 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     if cfg.trace_depth:
         from .types import (
             TR_BECAME_CANDIDATE, TR_BECAME_LEADER, TR_BECAME_PRE_CANDIDATE,
-            TR_COMMIT_ADVANCE, TR_READ_RELEASE, TR_SNAPSHOT_INSTALL,
+            TR_COMMIT_ADVANCE, TR_CONF_CHANGE_COMMIT, TR_CONF_CHANGE_ENTER,
+            TR_LEADER_TRANSFER, TR_READ_RELEASE, TR_SNAPSHOT_INSTALL,
             TR_STEPPED_DOWN, TR_TERM_BUMP,
         )
         D = cfg.trace_depth
+        NE = 11
         # All of one tick's events land in ONE batched scatter per lane:
         # event e's ring slot is n + (#events of this tick that fired
         # before it), so intra-tick order IS the canonical order above.
-        # Slots stay distinct within a group because at most 8 events
-        # fire per tick and trace_depth >= 8 (EngineConfig post-init).
-        ev_masks = jnp.stack([                               # [G, 8]
+        # Slots stay distinct within a group because at most NE events
+        # fire per tick and trace_depth >= NE + 1 (EngineConfig
+        # post-init).
+        ev_masks = jnp.stack([                               # [G, NE]
             term != s.term,
             (s.role == LEADER) & (role != LEADER),
             start_pre,
@@ -819,16 +1088,27 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
             sd,
             commit > s.commit,
             n_rel > 0,
+            # Membership plane: active-config change (enter/leave/learner/
+            # adoption/rollback), config-entry commit, TimeoutNow sent.
+            (w2 != w0) | (cidx2 != cidx0),
+            (cidx2 > 0) & (s.commit < cidx2) & (commit >= cidx2),
+            xfer_fire,
         ], axis=1) & active[:, None]
         ev_kinds = jnp.asarray([
             TR_TERM_BUMP, TR_STEPPED_DOWN, TR_BECAME_PRE_CANDIDATE,
             TR_BECAME_CANDIDATE, TR_BECAME_LEADER, TR_SNAPSHOT_INSTALL,
             TR_COMMIT_ADVANCE, TR_READ_RELEASE,
+            TR_CONF_CHANGE_ENTER, TR_CONF_CHANGE_COMMIT,
+            TR_LEADER_TRANSFER,
         ], I32)
-        ev_aux = jnp.stack([                                 # [G, 8]
+        ev_aux = jnp.stack([                                 # [G, NE]
             s.term, leader_id, jnp.zeros((G,), I32),
-            timer_cand.astype(I32), noop_idx, host.snap_idx,
+            # Candidacy cause: 0 prevote majority / 1 timer / 2 TimeoutNow
+            # (tn_cand implies timer_cand, so the sum is exactly 2).
+            timer_cand.astype(I32) + tn_cand.astype(I32),
+            noop_idx, host.snap_idx,
             commit, n_served,
+            w2, cidx2, xfer_to,
         ], axis=1)
         ev_i32 = ev_masks.astype(I32)
         prior = jnp.cumsum(ev_i32, axis=1) - ev_i32          # fired before e
@@ -836,23 +1116,23 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         # Ring write WITHOUT a scatter: a vmapped scatter inside the
         # fused scan lowers ~17x slower on CPU (measured; the one-hot-
         # over-D select ~3-6x).  Instead the fired events compact into a
-        # dense 8-wide window ([G, 8, 8] one-hot, D-independent), and the
-        # ring blends it in with one take_along_axis per varying lane —
-        # the same gather idiom as ring_terms_batch.  Ring position d
+        # dense NE-wide window ([G, NE, NE] one-hot, D-independent), and
+        # the ring blends it in with one take_along_axis per varying lane
+        # — the same gather idiom as ring_terms_batch.  Ring position d
         # takes window offset (d - n) mod D when that offset < n_new;
         # tick/term are uniform across a tick's events, so those two
         # lanes need only the write mask.
         off_hit = (prior[:, :, None] ==
-                   jnp.arange(8, dtype=I32)[None, None, :]) \
-            & ev_masks[:, :, None]                           # [G, 8, 8]
+                   jnp.arange(NE, dtype=I32)[None, None, :]) \
+            & ev_masks[:, :, None]                           # [G, NE, NE]
         win = lambda vals: jnp.where(
-            off_hit, vals[:, :, None], 0).sum(axis=1)        # [G, 8]
+            off_hit, vals[:, :, None], 0).sum(axis=1)        # [G, NE]
         rel = jnp.remainder(jnp.arange(D, dtype=I32)[None, :]
                             - jnp.remainder(trace.n, D)[:, None], D)
         write = rel < n_new[:, None]                         # [G, D]
-        rel_idx = jnp.minimum(rel, 7)
+        rel_idx = jnp.minimum(rel, NE - 1)
 
-        def put(ring, vals):                                 # vals [G, 8]
+        def put(ring, vals):                                 # vals [G, NE]
             return jnp.where(
                 write, jnp.take_along_axis(win(vals), rel_idx, axis=1),
                 ring)
@@ -860,7 +1140,7 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         trace = trace.replace(
             tick=jnp.where(write, now, trace.tick),
             kind=put(trace.kind,
-                     jnp.broadcast_to(ev_kinds[None, :], (G, 8))),
+                     jnp.broadcast_to(ev_kinds[None, :], (G, NE))),
             term=jnp.where(write, term[:, None], trace.term),
             aux=put(trace.aux, ev_aux),
             n=trace.n + n_new,
@@ -900,6 +1180,9 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         debug_viol = flag(debug_viol, (send_next < next_idx).any(axis=1), 7)
         # 8: read FIFO length out of range.
         debug_viol = flag(debug_viol, (rq_len < 0) | (rq_len > K), 8)
+        # 9: active config with an empty voter set (a config entry can
+        # never be built that way; seeing one means ring corruption).
+        debug_viol = flag(debug_viol, voters2 == 0, 9)
 
     new_state = RaftState(
         node_id=s.node_id, now=now, rng=rng, active=active,
@@ -915,13 +1198,15 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         read_evid=read_evid,
         rq_idx=rq_idx, rq_stamp=rq_stamp, rq_n=rq_n,
         rq_head=rq_head, rq_len=rq_len,
+        conf_idx=cidx2, conf_word=w2,
+        xfer_to=xfer_to, xfer_dl=xfer_dl,
         trace=trace,
     )
     outbox = Messages(
         ae_valid=out_ae_valid, ae_term=out_ae_term,
         ae_prev_idx=out_ae_prev_idx, ae_prev_term=out_ae_prev_term,
         ae_commit=out_ae_commit, ae_n=out_ae_n, ae_ents=out_ae_ents,
-        ae_occ=out_ae_occ, ae_tick=out_ae_tick,
+        ae_cents=out_ae_cents, ae_occ=out_ae_occ, ae_tick=out_ae_tick,
         aer_valid=out_aer_valid, aer_term=out_aer_term,
         aer_success=out_aer_success, aer_match=out_aer_match,
         aer_empty=out_aer_empty, aer_occ=out_aer_occ,
@@ -934,18 +1219,25 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         rvr_echo=out_rvr_echo,
         is_valid=out_is_valid, is_term=out_is_term, is_idx=out_is_idx,
         is_last_term=out_is_last_term, is_probe=out_is_probe,
+        is_conf=out_is_conf,
         isr_valid=out_isr_valid, isr_term=out_isr_term,
         isr_success=out_isr_success, isr_probe=out_isr_probe,
+        tn_valid=out_tn_valid, tn_term=out_tn_term,
     )
     info = StepInfo(
         submit_start=sub_start, submit_acc=n_acc, dirty=dirty,
         appended_from=app_from, appended_to=app_to, log_tail=log.last,
         commit=commit, leader=leader_id, ready=ready, snap_req=snap_req,
         snap_req_from=snap_from, snap_req_idx=snap_idx_o,
-        snap_req_term=snap_term_o, noop_idx=noop_idx, noop_term=noop_term,
+        snap_req_term=snap_term_o, snap_req_conf=snap_conf_o,
+        noop_idx=noop_idx, noop_term=noop_term,
         read_acc=n_read, read_index=read_index_out,
         read_rel=n_rel, read_served=n_served,
         read_lease=read_lease_hit, read_abort=read_abort,
+        conf_app_idx=conf_app_idx, conf_app_term=conf_app_term,
+        conf_app_word=conf_app_word,
+        conf_word=w2, conf_idx=cidx2, conf_pending=cidx2 > commit,
+        xfer_fired=xfer_fire, xfer_abort=xfer_abort,
         debug_viol=debug_viol,
     )
     return new_state, outbox, info

@@ -55,6 +55,58 @@ I32 = jnp.int32
 # compaction floor keeps RocksDB keys bounded.
 I32_SAFE_MAX = (1 << 31) - (1 << 20)
 
+# ---------------------------------------------------------------------------
+# Membership plane: packed config words (Raft §6 joint consensus).
+#
+# A group's configuration is a single int32 word packing three peer-slot
+# bitmasks plus a marker flag:
+#
+#     bits  0..9   voters      — C_old while joint, else THE voter set
+#     bits 10..19  voters_new  — C_new; nonzero iff the config is JOINT
+#     bits 20..29  learners    — replicate but never count toward any quorum
+#     bit  30      CONF_FLAG   — set on every real config word (a zero in
+#                                the conf ring means "not a config entry")
+#
+# The packing bounds n_peers at CONF_MASK_BITS slots (asserted by
+# EngineConfig); the reference's clusters are 3-9 nodes, and the Pallas
+# sorting network unrolls the same range.  The layout constants are OWNED
+# by utils/tracelog.py (imported below) so the engine-free dump decoder
+# unpacks config words from the same single definition.
+#
+# §6 apply-on-append contract (see LogState.conf): config-change entries
+# travel the NORMAL log, and a node uses the configuration of the LATEST
+# config entry present in its log — committed or not — the moment the
+# entry is appended.  Joint entries (C_old,new) require a quorum in BOTH
+# voter sets for elections and commits; the C_new entry that leaves the
+# joint state is auto-appended by the leader once C_old,new commits.  One
+# change is in flight per group at a time (the next intake is refused
+# until the previous config entry commits).  Truncation of an uncommitted
+# config entry rolls the config back automatically: the active config is
+# DERIVED from the log every tick, never stored separately.
+# ---------------------------------------------------------------------------
+from ..utils.tracelog import (  # noqa: E402  (decoder-owned layout)
+    CONF_FLAG, CONF_LRN_SHIFT, CONF_MASK, CONF_MASK_BITS, CONF_NEW_SHIFT,
+)
+
+
+def conf_pack(voters, voters_new=0, learners=0):
+    """Pack a config word (python ints or int32 arrays; CONF_FLAG set)."""
+    return (CONF_FLAG | (voters & CONF_MASK)
+            | ((voters_new & CONF_MASK) << CONF_NEW_SHIFT)
+            | ((learners & CONF_MASK) << CONF_LRN_SHIFT))
+
+
+def conf_voters_of(word):
+    return (word >> 0) & CONF_MASK
+
+
+def conf_new_of(word):
+    return (word >> CONF_NEW_SHIFT) & CONF_MASK
+
+
+def conf_learners_of(word):
+    return (word >> CONF_LRN_SHIFT) & CONF_MASK
+
 
 @dataclasses.dataclass(frozen=True)
 class EngineConfig:
@@ -124,9 +176,20 @@ class EngineConfig:
                                   #     is None, so the state pytree and the
                                   #     compiled step are bit-identical to a
                                   #     build without the feature.
+    quorum_fixed: bool = False    # BENCH-ONLY baseline: commit quorum via
+                                  #     the legacy fixed-majority order
+                                  #     statistic over all P slots instead
+                                  #     of the masked membership-aware
+                                  #     kernel.  ONLY valid while every
+                                  #     group keeps the boot full-voter
+                                  #     config (the BENCH_MEMBER A/B uses
+                                  #     it to price the masked kernel).
 
     def __post_init__(self):
         assert self.n_peers >= 1
+        assert self.n_peers <= CONF_MASK_BITS, \
+            "membership plane packs voter/learner masks into one i32 conf " \
+            f"word ({CONF_MASK_BITS} bits per mask) — n_peers is bounded"
         assert self.log_slots & (self.log_slots - 1) == 0, "log_slots must be a power of 2"
         assert self.batch <= self.log_slots
         assert self.heartbeat_ticks < self.election_ticks
@@ -136,9 +199,9 @@ class EngineConfig:
         assert self.read_slots >= 1, "read plane needs >= 1 pending slot"
         assert self.read_fresh_ticks >= 2, \
             "lease evidence needs the 2-tick delivery round trip"
-        assert self.trace_depth == 0 or self.trace_depth >= 8, \
-            "flight-recorder rings need >= 8 slots (one tick can emit " \
-            "up to 8 events, batched into one scatter per lane)"
+        assert self.trace_depth == 0 or self.trace_depth >= 12, \
+            "flight-recorder rings need >= 12 slots (one tick can emit " \
+            "up to 11 events, batched into one scatter per lane)"
 
     @property
     def majority(self) -> int:
@@ -156,8 +219,21 @@ class LogState:
     """
 
     term: jax.Array       # [G, L] int32 — term of entry at slot (index % L)
+    conf: jax.Array       # [G, L] int32 — packed config word of the entry at
+                          #   slot (index % L); 0 = not a config entry.  The
+                          #   §6 membership plane: a group's ACTIVE config is
+                          #   the word of the latest config entry in
+                          #   (base, last], else ``base_conf`` (apply-on-
+                          #   append — see the module-level contract above
+                          #   CONF_MASK_BITS).  Travels with entries over
+                          #   AppendEntries (Messages.ae_cents) so laggards
+                          #   and truncation rollbacks need no special cases.
     base: jax.Array       # [G] int32 — compaction floor ("epoch"); entries (base, last] live
     base_term: jax.Array  # [G] int32 — term of the entry at `base` (snapshot milestone term)
+    base_conf: jax.Array  # [G] int32 — packed config as of index ``base``
+                          #   (the snapshot milestone's config; what the
+                          #   derivation falls back to when no config entry
+                          #   is live)
     last: jax.Array       # [G] int32 — last appended index (0 = empty)
 
 
@@ -175,19 +251,24 @@ class LogState:
 #   TR_TERM_BUMP            aux = previous term
 #   TR_STEPPED_DOWN         aux = new leader hint (NIL if unknown)
 #   TR_BECAME_PRE_CANDIDATE aux = 0
-#   TR_BECAME_CANDIDATE     aux = 0 prevote majority / 1 timer expiry
+#   TR_BECAME_CANDIDATE     aux = 0 prevote majority / 1 timer expiry /
+#                           2 TimeoutNow (leadership transfer)
 #                           ("elections by cause" decodes from this)
 #   TR_BECAME_LEADER        aux = §8 no-op index (0: ring full, none)
 #   TR_SNAPSHOT_INSTALL     aux = installed milestone index
 #   TR_COMMIT_ADVANCE       aux = new commit index
 #   TR_READ_RELEASE         aux = individual reads released
 #   TR_CRASH_RESTART        aux = durable log tail survived into boot
+#   TR_CONF_CHANGE_ENTER    aux = the new packed config word
+#   TR_CONF_CHANGE_COMMIT   aux = the committed config entry's index
+#   TR_LEADER_TRANSFER      aux = transfer target peer slot
 # The scalar oracle (testkit/oracle.py) emits the identical stream, so
 # the recorder itself is parity-checked; utils/tracelog.py decodes.
 # ---------------------------------------------------------------------------
 from ..utils.tracelog import (  # noqa: F401  (re-exported taxonomy)
     TR_BECAME_CANDIDATE, TR_BECAME_LEADER, TR_BECAME_PRE_CANDIDATE,
-    TR_COMMIT_ADVANCE, TR_CRASH_RESTART, TR_READ_RELEASE,
+    TR_COMMIT_ADVANCE, TR_CONF_CHANGE_COMMIT, TR_CONF_CHANGE_ENTER,
+    TR_CRASH_RESTART, TR_LEADER_TRANSFER, TR_READ_RELEASE,
     TR_SNAPSHOT_INSTALL, TR_STEPPED_DOWN, TR_TERM_BUMP, TRACE_EVENTS,
 )
 
@@ -306,6 +387,26 @@ class RaftState:
 
     elect_deadline: jax.Array # [G] int32 — election timer deadline (tick)
     hb_due: jax.Array         # [G] int32 — next heartbeat tick (leader)
+
+    # Derived-config cache (§6 membership plane): ALWAYS equal to
+    # ``latest_conf(log, log.last)`` at rest — the step consumes it as
+    # the tick-start view C0 (vote/PreVote tallies, campaign gating) and
+    # re-derives only after the tick's log mutations, so the [G, L] conf
+    # sweep runs once per tick, not twice.  Consistent across
+    # crash_restart by construction (both the cache and the log are
+    # durable-state functions).
+    conf_idx: jax.Array       # [G] int32 — active config entry index (0 =
+                              #   the config comes from log.base_conf)
+    conf_word: jax.Array      # [G] int32 — active packed config word
+
+    # Leadership transfer (TimeoutNow, Raft dissertation §3.10).  While a
+    # transfer is pending the leader FENCES client submissions and config
+    # changes, waits for the target's match to reach its log end, then
+    # sends TimeoutNow; the target campaigns immediately, skipping
+    # PreVote.  Volatile leader state: cleared on role/term change, on
+    # the deadline, and by crash_restart.
+    xfer_to: jax.Array        # [G] int32 — transfer target peer (NIL none)
+    xfer_dl: jax.Array        # [G] int32 — abort deadline (own-clock tick)
 
     # Linearizable read plane (leader-only lanes; ReadIndex §6.4 of the
     # Raft dissertation, vectorized).  A read batch is STAMPED with the
@@ -443,6 +544,13 @@ def crash_restart(cfg: EngineConfig, s: "RaftState") -> "RaftState":
         read_evid=z(G, P),
         rq_idx=z(G, K), rq_stamp=z(G, K), rq_n=z(G, K),
         rq_head=z(G), rq_len=z(G),
+        # A pending leadership transfer is volatile leader state.  The
+        # CONFIG (conf_idx/conf_word cache) is not reset: it derives from
+        # the log (conf ring + base_conf), which survives like
+        # term/ballot — the §6 voter set is durable across
+        # crash-restarts by construction.
+        xfer_to=jnp.full((G,), NIL, I32),
+        xfer_dl=z(G),
     )
 
 
@@ -473,6 +581,12 @@ class Messages:
                              #   heartbeats release hb_inflight (a reply to a
                              #   window-full EXEMPT heartbeat must not free a
                              #   slot whose own ack was lost — ADVICE r4)
+    ae_cents: jax.Array      # [P, G, B] int32 — per-entry packed config
+                             #   words (0 = not a config entry): the §6
+                             #   membership plane rides the log, so every
+                             #   shipped entry carries its config word and
+                             #   followers adopt configs apply-on-append
+                             #   exactly as they adopt terms
     ae_tick: jax.Array       # [P, G] int32 — sender's own clock at send,
                              #   echoed back as aer_tick: the read plane's
                              #   barrier-evidence anchor (strict ReadIndex
@@ -521,10 +635,21 @@ class Messages:
     is_probe: jax.Array      # [P, G] bool — window-exempt re-offer (heartbeat
                              #   cadence): echoed back so the reply does not
                              #   release a slot the offer never took
+    is_conf: jax.Array       # [P, G] int32 — packed config as of the offered
+                             #   milestone (the sender's base_conf): the
+                             #   installing follower's new base_conf, round-
+                             #   tripped through the host via
+                             #   StepInfo.snap_req_conf / HostInbox.snap_conf
     isr_valid: jax.Array     # [P, G] bool
     isr_term: jax.Array      # [P, G] int32
     isr_success: jax.Array   # [P, G] bool
     isr_probe: jax.Array     # [P, G] bool — echo of is_probe
+
+    # TimeoutNow (leadership transfer, §3.10): the leader tells a caught-up
+    # voter to campaign immediately, skipping PreVote and the leader-
+    # stickiness lease.  Stale-term copies are ignored by the term check.
+    tn_valid: jax.Array      # [P, G] bool
+    tn_term: jax.Array       # [P, G] int32 — sender's term (receiver must match)
 
     @classmethod
     def empty(cls, cfg: EngineConfig) -> "Messages":
@@ -534,7 +659,8 @@ class Messages:
         return cls(
             ae_valid=f(P, G), ae_term=z(P, G), ae_prev_idx=z(P, G),
             ae_prev_term=z(P, G), ae_commit=z(P, G), ae_n=z(P, G),
-            ae_ents=z(P, G, B), ae_occ=f(P, G), ae_tick=z(P, G),
+            ae_ents=z(P, G, B), ae_cents=z(P, G, B), ae_occ=f(P, G),
+            ae_tick=z(P, G),
             aer_valid=f(P, G), aer_term=z(P, G), aer_success=f(P, G),
             aer_match=z(P, G), aer_empty=f(P, G), aer_occ=f(P, G),
             aer_tick=z(P, G),
@@ -543,9 +669,10 @@ class Messages:
             rvr_valid=f(P, G), rvr_term=z(P, G), rvr_granted=f(P, G),
             rvr_prevote=f(P, G), rvr_echo=z(P, G),
             is_valid=f(P, G), is_term=z(P, G), is_idx=z(P, G),
-            is_last_term=z(P, G), is_probe=f(P, G),
+            is_last_term=z(P, G), is_probe=f(P, G), is_conf=z(P, G),
             isr_valid=f(P, G), isr_term=z(P, G), isr_success=f(P, G),
             isr_probe=f(P, G),
+            tn_valid=f(P, G), tn_term=z(P, G),
         )
 
 
@@ -563,6 +690,23 @@ class HostInbox:
     # the log floor (reference RaftRoutine.compactLog:365-400).  The milestone
     # term is read from the device-side ring, so only the index is needed.
     compact_to: jax.Array      # [G] int32 (0 = no-op)
+    # Membership plane (§6): the TARGET configuration a client asked for.
+    # 0 in ``conf_voters`` = no request (a voter set can never be empty).
+    # The leader turns a request into ONE config entry: a joint C_old,new
+    # entry when the voter set changes, a simple entry when only the
+    # learner set moves; the C_new leave entry is auto-appended when the
+    # joint entry commits.  Intake is refused (silently — the host
+    # re-offers) while another change is in flight, while a leadership
+    # transfer is pending, or when the request equals the active config.
+    conf_voters: jax.Array     # [G] int32 — target voter bitmask (0 = none)
+    conf_learners: jax.Array   # [G] int32 — target learner bitmask
+    # Leadership transfer request: target peer slot (NIL = none).  The
+    # device latches it into RaftState.xfer_to when this node leads.
+    xfer_target: jax.Array     # [G] int32
+    # Config at an installed snapshot's milestone (0 = keep current
+    # base_conf; paired with snap_done/snap_idx/snap_term — round-tripped
+    # from the leader's InstallSnapshot offer, StepInfo.snap_req_conf).
+    snap_conf: jax.Array       # [G] int32
     # Linearizable read plane.
     read_n: jax.Array          # [G] int32 — linearizable reads offered this
                                #   tick (one batch; stamped together when a
@@ -593,6 +737,10 @@ class HostInbox:
             snap_idx=jnp.zeros((G,), I32),
             snap_term=jnp.zeros((G,), I32),
             compact_to=jnp.zeros((G,), I32),
+            conf_voters=jnp.zeros((G,), I32),
+            conf_learners=jnp.zeros((G,), I32),
+            xfer_target=jnp.full((G,), NIL, I32),
+            snap_conf=jnp.zeros((G,), I32),
             read_n=jnp.zeros((G,), I32),
             read_veto=jnp.asarray(False),
             durable_tail=None,
@@ -624,6 +772,9 @@ class StepInfo:
     snap_req_from: jax.Array  # [G] int32 — peer to download from
     snap_req_idx: jax.Array   # [G] int32
     snap_req_term: jax.Array  # [G] int32
+    snap_req_conf: jax.Array  # [G] int32 — config at the offered milestone
+                              #   (the offer's is_conf; feed back as
+                              #   HostInbox.snap_conf on completion)
     noop_idx: jax.Array       # [G] int32 — index of the own-term NO-OP a fresh
                               #   leader appended this tick (0 = none; Raft §8
                               #   liveness — the host stages it with an empty
@@ -651,6 +802,26 @@ class StepInfo:
                               #   (leadership/term changed); the host fails
                               #   them with NotLeader — clients retry safely
                               #   (reads never enter the log)
+    # Membership plane outputs.
+    conf_app_idx: jax.Array   # [G] int32 — index of the config entry THIS
+                              #   node appended as leader this tick (0 =
+                              #   none; intake accept or the automatic
+                              #   joint-leave).  The host stages it durably
+                              #   with an empty payload, like the §8 no-op.
+    conf_app_term: jax.Array  # [G] int32 — that entry's term
+    conf_app_word: jax.Array  # [G] int32 — that entry's packed config word
+    conf_word: jax.Array      # [G] int32 — the ACTIVE config after this
+                              #   tick (latest config entry in the log, else
+                              #   base_conf) — the host mirror's source
+    conf_idx: jax.Array       # [G] int32 — that entry's log index (0 = the
+                              #   config comes from base_conf)
+    conf_pending: jax.Array   # [G] bool — a config entry is in flight
+                              #   (conf_idx > commit): intake is fenced
+    xfer_fired: jax.Array     # [G] bool — TimeoutNow sent to the transfer
+                              #   target this tick (its match reached our
+                              #   log end)
+    xfer_abort: jax.Array     # [G] bool — a pending transfer was dropped
+                              #   (deadline passed or leadership/term moved)
     debug_viol: jax.Array     # [G] int32 — in-kernel invariant violation code
                               #   (0 = ok; codes in step.py DEBUG_CODES).
                               #   Always zeros unless cfg.debug_checks.
@@ -667,21 +838,41 @@ class StepInfo:
             ready=jnp.zeros((G,), jnp.bool_),
             snap_req=jnp.zeros((G,), jnp.bool_),
             snap_req_from=z(), snap_req_idx=z(), snap_req_term=z(),
+            snap_req_conf=z(),
             noop_idx=z(), noop_term=z(),
             read_acc=z(), read_index=z(), read_rel=z(), read_served=z(),
             read_lease=jnp.zeros((G,), jnp.bool_),
             read_abort=jnp.zeros((G,), jnp.bool_),
+            conf_app_idx=z(), conf_app_term=z(), conf_app_word=z(),
+            conf_word=z(), conf_idx=z(),
+            conf_pending=jnp.zeros((G,), jnp.bool_),
+            xfer_fired=jnp.zeros((G,), jnp.bool_),
+            xfer_abort=jnp.zeros((G,), jnp.bool_),
             debug_viol=z(),
         )
 
 
+def boot_conf_word(cfg: EngineConfig, n_voters: int | None = None) -> int:
+    """The boot configuration word: the first ``n_voters`` slots (default
+    all P) are voters, no joint set, no learners."""
+    nv = cfg.n_peers if n_voters is None else n_voters
+    assert 1 <= nv <= cfg.n_peers
+    return int(conf_pack((1 << nv) - 1))
+
+
 def init_state(cfg: EngineConfig, node_id: int, seed: int = 0,
-               n_active: int | None = None) -> RaftState:
+               n_active: int | None = None,
+               n_voters: int | None = None) -> RaftState:
     """Fresh boot state: every group a follower at term 0 with an empty log.
 
     The staggered election deadlines come from the per-group randomized
     timeout, seeded per node — the vectorized analog of the reference's
     randomized election window (support/RaftConfig.java:187-190).
+
+    ``n_voters`` bounds the BOOT voter set to the first n slots (default:
+    all P).  Slots outside it are spare capacity the membership plane can
+    add later (learner catch-up -> promote), the shape rebalance walks
+    start from.
     """
     G, P, K = cfg.n_groups, cfg.n_peers, cfg.read_slots
     key = jax.random.PRNGKey(seed * 7919 + node_id)
@@ -701,7 +892,11 @@ def init_state(cfg: EngineConfig, node_id: int, seed: int = 0,
         leader_id=jnp.full((G,), NIL, I32),
         commit=z(G),
         applied=z(G),
-        log=LogState(term=z(G, cfg.log_slots), base=z(G), base_term=z(G), last=z(G)),
+        log=LogState(term=z(G, cfg.log_slots), conf=z(G, cfg.log_slots),
+                     base=z(G), base_term=z(G),
+                     base_conf=jnp.full((G,), boot_conf_word(cfg, n_voters),
+                                        I32),
+                     last=z(G)),
         next_idx=jnp.ones((G, P), I32),
         match_idx=z(G, P),
         send_next=jnp.ones((G, P), I32),
@@ -720,6 +915,10 @@ def init_state(cfg: EngineConfig, node_id: int, seed: int = 0,
         read_evid=z(G, P),
         rq_idx=z(G, K), rq_stamp=z(G, K), rq_n=z(G, K),
         rq_head=z(G), rq_len=z(G),
+        conf_idx=z(G),
+        conf_word=jnp.full((G,), boot_conf_word(cfg, n_voters), I32),
+        xfer_to=jnp.full((G,), NIL, I32),
+        xfer_dl=z(G),
         trace=(TraceState.empty(G, cfg.trace_depth)
                if cfg.trace_depth else None),
     )

@@ -29,8 +29,10 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+import os
+
 from ..transport.codec import PayloadRun
-from .wal import WalStore
+from .wal import ConfMeta, WalStore
 
 
 class LogStore:
@@ -43,6 +45,9 @@ class LogStore:
         creation, so recovery always reads the written layout."""
         self.wal = WalStore(path, segment_bytes, force_python=force_python,
                             shards=shards)
+        # Membership sidecar (§6 durable config): live config entries +
+        # floor config per group, flushed inside sync()'s barrier.
+        self.conf = ConfMeta(os.path.join(path, "conf_meta.json"))
         # group -> ([run starts], [PayloadRun]) sorted by start: the hot
         # mirror of the live window as contiguous arena runs — the same
         # currency the wire codec and the staging path speak, so cache
@@ -232,9 +237,26 @@ class LogStore:
             g_all, i_all, t_all,
             b"".join(sp[2] for sp in spans), offs_all, lens_all)
 
+    def put_conf(self, g: int, idx: int, word: int) -> None:
+        """Record a config entry (§6 membership plane) so recovery can
+        rebuild the conf ring; durable at the next sync()."""
+        self.conf.put(g, idx, word)
+
+    def conf_overwrite(self, g: int, start: int) -> None:
+        """Mirror an entry overwrite at ``start`` into the membership
+        sidecar: recorded config entries at >= start die (the WAL's
+        replay drops that suffix, and a conflicting adoption may replace
+        a config entry with an ordinary one)."""
+        self.conf.truncate(g, start - 1)
+
+    def conf_export(self) -> dict:
+        """{g: (floor_word, {idx: word})} — recovery input."""
+        return self.conf.export()
+
     def truncate_to(self, g: int, tail: int) -> None:
         """Ensure the durable suffix beyond `tail` dies (conflict/snapshot
         discard).  No-op if the durable tail is already <= tail."""
+        self.conf.truncate(g, tail)
         if self._durable_tail.get(g, self.wal.tail(g)) > tail:
             self.wal.truncate(g, tail + 1)
             self._durable_tail[g] = tail
@@ -270,8 +292,13 @@ class LogStore:
             append(g, t, b)
             st[g] = (t, b)
 
-    def set_floor(self, g: int, index: int, term: int) -> None:
-        """Raise the compaction floor (snapshot milestone)."""
+    def set_floor(self, g: int, index: int, term: int,
+                  conf_word: int = 0) -> None:
+        """Raise the compaction floor (snapshot milestone).  ``conf_word``
+        (nonzero) additionally pins the config AS OF the milestone — the
+        snapshot-install path passes the offer's config; ordinary
+        compaction folds the group's own recorded entries instead."""
+        self.conf.set_floor(g, index, conf_word)
         if index <= self.wal.floor(g):
             return
         self.wal.milestone(g, index, term)
@@ -298,12 +325,15 @@ class LogStore:
         scratch (the reference deletes the group's RocksDB dir,
         command/storage/RocksStateLoader.java:48-59)."""
         self.wal.reset(g)
+        self.conf.reset(g)
         self._cache.pop(g, None)
         self._stable.pop(g, None)
         self._durable_tail.pop(g, None)
 
     def sync(self) -> None:
-        """The durability barrier: one fsync covering all staged writes."""
+        """The durability barrier: one fsync covering all staged writes
+        (the membership sidecar flushes inside the same barrier)."""
+        self.conf.flush()
         self.wal.sync()
 
     def checkpoint(self) -> None:
@@ -460,7 +490,7 @@ def restore_raft_state(cfg, node_id: int, store: LogStore, seed: int = 0):
     """
     import jax.numpy as jnp
 
-    from ..core.types import NIL, init_state
+    from ..core.types import NIL, boot_conf_word, init_state
 
     state = init_state(cfg, node_id, seed=seed)
     G, L = cfg.n_groups, cfg.log_slots
@@ -500,12 +530,43 @@ def restore_raft_state(cfg, node_id: int, store: LogStore, seed: int = 0):
             store.truncate_to(g, int(last[g]))
     if len(suspect):
         store.sync()
+    # Membership restore (§6 durable config): rebuild the conf ring from
+    # the WAL's membership sidecar — live config entries back into their
+    # ring slots, the floor config into base_conf.  Entries the WAL
+    # truncated after their last sidecar write are dropped by the window
+    # bound; a store without the sidecar (LogStoreSPI products) boots the
+    # full-voter config, exactly like a fresh lane.
+    cring = np.zeros((G, L), np.int32)
+    bconf = np.full(G, boot_conf_word(cfg), np.int32)
+    # The derived-config cache lanes (RaftState.conf_idx/conf_word) must
+    # match latest_conf(log, last) at boot — rebuilt here alongside the
+    # ring.
+    conf_idx = np.zeros(G, np.int32)
+    conf_word = bconf.copy()
+    conf_export = getattr(store, "conf_export", None)
+    if conf_export is not None:
+        for g, (floor_word, entries) in conf_export().items():
+            if g >= G:
+                continue
+            if floor_word:
+                bconf[g] = floor_word
+            for idx, word in sorted(entries.items()):
+                if base[g] < idx <= last[g]:
+                    cring[g, idx % L] = word
+                    conf_idx[g], conf_word[g] = idx, word
+                elif idx <= base[g]:
+                    bconf[g] = word
+            if conf_idx[g] == 0:
+                conf_word[g] = bconf[g]
     return state.replace(
+        conf_idx=jnp.asarray(conf_idx), conf_word=jnp.asarray(conf_word),
         term=jnp.asarray(term), voted_for=jnp.asarray(voted),
         commit=jnp.asarray(commit),
         log=state.log.replace(
-            term=jnp.asarray(ring), base=jnp.asarray(base),
-            base_term=jnp.asarray(base_term), last=jnp.asarray(last)),
+            term=jnp.asarray(ring), conf=jnp.asarray(cring),
+            base=jnp.asarray(base),
+            base_term=jnp.asarray(base_term),
+            base_conf=jnp.asarray(bconf), last=jnp.asarray(last)),
         next_idx=jnp.asarray(np.broadcast_to(last[:, None] + 1,
                                              (G, cfg.n_peers)).copy()),
         send_next=jnp.asarray(np.broadcast_to(last[:, None] + 1,

@@ -649,6 +649,122 @@ def _signed(v: int) -> int:
     return v - (1 << 64) if v >= (1 << 63) else v
 
 
+class ConfMeta:
+    """Durable membership-config sidecar: the WAL meta file that lets
+    recovery restore the §6 active voter set.
+
+    The engine's conf ring (core/types.py LogState.conf) carries one
+    packed config word per live config entry; the WAL proper persists
+    entry (term, payload) only — config entries travel with EMPTY
+    payloads like the §8 no-op.  This sidecar records, per group, every
+    LIVE config entry's (index, word) plus the config as of the
+    compaction floor, so ``restore_raft_state`` rebuilds the conf ring
+    and base_conf exactly.  Maintained write-through by the LogStore
+    (put/truncate/set_floor/reset mirror the entry paths) and flushed —
+    atomic tmp+rename+fsync — inside the store's ``sync()`` barrier, so
+    a config is durable before any RPC built on it leaves the node.
+    Config changes are rare; the whole file is a few entries per group
+    that ever reconfigured, and a flush only happens on change."""
+
+    def __init__(self, path: str):
+        import json
+        self.path = path
+        self._g: dict = {}       # g -> {"floor": word, "entries": {idx: w}}
+        self._dirty = False
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            for g, ent in doc.get("groups", {}).items():
+                self._g[int(g)] = {
+                    "floor": int(ent.get("floor", 0)),
+                    "entries": {int(i): int(w)
+                                for i, w in ent.get("entries", {}).items()},
+                }
+        except (OSError, ValueError):
+            pass
+
+    def _ent(self, g: int) -> dict:
+        ent = self._g.get(g)
+        if ent is None:
+            ent = self._g[g] = {"floor": 0, "entries": {}}
+        return ent
+
+    def put(self, g: int, idx: int, word: int) -> None:
+        ent = self._ent(g)
+        # Overwrite semantics like the WAL itself: an append at idx kills
+        # any recorded config entries at >= idx (they were truncated).
+        for i in [i for i in ent["entries"] if i > idx]:
+            del ent["entries"][i]
+        ent["entries"][idx] = word
+        self._dirty = True
+
+    def truncate(self, g: int, tail: int) -> None:
+        ent = self._g.get(g)
+        if not ent:
+            return
+        drop = [i for i in ent["entries"] if i > tail]
+        for i in drop:
+            del ent["entries"][i]
+        if drop:
+            self._dirty = True
+
+    def set_floor(self, g: int, index: int, conf_word: int = 0) -> None:
+        """Fold config entries at/under the new floor into the floor word
+        (the latest one wins — it IS the config as of ``index``).  A
+        nonzero ``conf_word`` then pins the floor config explicitly (the
+        snapshot-install path: the offered milestone's config is the
+        config AS OF ``index``, newer than or equal to any folded
+        entry)."""
+        ent = self._g.get(g)
+        if ent is None:
+            if not conf_word:
+                return
+            ent = self._ent(g)
+        folded = [i for i in sorted(ent["entries"]) if i <= index]
+        for i in folded:
+            ent["floor"] = ent["entries"].pop(i)
+        if conf_word:
+            ent["floor"] = int(conf_word)
+        if folded or conf_word:
+            self._dirty = True
+
+    def reset(self, g: int) -> None:
+        if self._g.pop(g, None) is not None:
+            self._dirty = True
+
+    def export(self) -> dict:
+        """{g: (floor_word, {idx: word})} for recovery (groups that ever
+        reconfigured only)."""
+        return {g: (ent["floor"], dict(ent["entries"]))
+                for g, ent in self._g.items()}
+
+    def flush(self) -> None:
+        if not self._dirty:
+            return
+        import json
+        doc = {"groups": {str(g): {"floor": ent["floor"],
+                                   "entries": {str(i): w for i, w
+                                               in ent["entries"].items()}}
+                          for g, ent in self._g.items()}}
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        # The rename itself must be durable before the caller's barrier
+        # completes (same rule as the WAL GC swap): fsync the directory.
+        try:
+            dfd = os.open(os.path.dirname(self.path) or ".", os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass
+        self._dirty = False
+
+
 _SHARD_META = "wal_shards.json"
 
 

@@ -46,7 +46,7 @@ import numpy as np
 from ..core.step import node_step
 from ..core.types import (
     I32, I32_SAFE_MAX, LEADER, NIL, EngineConfig, HostInbox, Messages,
-    StepInfo, init_state,
+    StepInfo, boot_conf_word as _boot_conf_word, init_state,
 )
 from ..log.store import LogStore, restore_raft_state
 from ..machine.dispatch import ApplyDispatcher
@@ -370,6 +370,25 @@ class RaftNode:
         self._last_tick_wall: Optional[float] = None
         self._read_veto_hold = 0   # ticks of veto left after a pause
 
+        # Membership plane (§6): pending change/transfer requests, offered
+        # to the device every tick until accepted or failed (the device
+        # refuses silently while another change is in flight; acceptance
+        # latches into the log).  Mirrors of the device's active config
+        # feed membership() and the request-settled checks.
+        self._member_lock = threading.Lock()
+        # g -> [target_voters, target_learners, Future, accepted: bool]
+        self._conf_pending: Dict[int, list] = {}
+        # g -> [target_peer, Future, fired: bool]
+        self._xfer_pending: Dict[int, list] = {}
+        self.h_conf_word = np.asarray(self.state.conf_word).copy()
+        self.h_conf_idx = np.asarray(self.state.conf_idx).copy()
+        self.h_conf_pending = np.asarray(
+            self.h_conf_idx > np.asarray(self.state.commit)).copy()
+        # Snapshot-install config round trip: the offer's config word,
+        # pended at request time, fed back as HostInbox.snap_conf on
+        # completion (g -> (offered_idx, word)).
+        self._snap_conf: Dict[int, Tuple[int, int]] = {}
+
         # Snapshot downloads: a BOUNDED global worker pool fetches bytes to
         # temp files (reference: ONE dedicated snapshot NIO thread,
         # transport/NettyCluster.java:42-43 — thread-per-lagging-group
@@ -448,6 +467,16 @@ class RaftNode:
         # Counter/gauge/histogram registry (SURVEY §5: the build must add
         # commits/sec, election counts, per-step latency histograms).
         self.metrics = Metrics()
+        # Membership counters render at 0 on /metrics from boot
+        # (tests/test_metrics_prom.py asserts the exposition carries them).
+        for _c in ("membership_changes_entered",
+                   "membership_changes_committed",
+                   "membership_changes_aborted",
+                   "leadership_transfers_attempted",
+                   "leadership_transfers_succeeded",
+                   "leadership_transfers_aborted",
+                   "timeout_now_sent"):
+            self.metrics[_c] += 0
         # Flight-recorder drain (cfg.trace_depth > 0): per-group decoded
         # timelines + labeled metrics (elections by cause, leader churn)
         # harvested from the device event rings each tick.  Inert when
@@ -891,6 +920,8 @@ class RaftNode:
                     self._reject_reads(
                         g, ObsoleteContextError(f"group {g} closed"),
                         drop_released=True)
+                    self._reject_membership(
+                        g, ObsoleteContextError(f"group {g} closed"))
                 if purge:
                     purged.append(g)
             self.state = self.state.replace(active=jnp.asarray(act))
@@ -947,10 +978,25 @@ class RaftNode:
         snap_done = np.zeros(G, bool)
         snap_idx = np.zeros(G, np.int32)
         snap_term = np.zeros(G, np.int32)
-        for g, idx, term in self._install_snapshots(fetched):
+        snap_conf = np.zeros(G, np.int32)
+        for g, idx, term, cw in self._install_snapshots(fetched):
             snap_done[g] = True
             snap_idx[g] = idx
             snap_term[g] = term
+            snap_conf[g] = cw
+        # Membership plane: re-offer every pending change/transfer until
+        # the device latches it (intake is idempotent — an accepted change
+        # equals the active config or is fenced as in-flight, so a
+        # duplicate offer can never append a second entry).
+        conf_voters = np.zeros(G, np.int32)
+        conf_learners = np.zeros(G, np.int32)
+        xfer_target = np.full(G, NIL, np.int32)
+        with self._member_lock:
+            for g, ent in self._conf_pending.items():
+                conf_voters[g] = ent[0]
+                conf_learners[g] = ent[1]
+            for g, ent in self._xfer_pending.items():
+                xfer_target[g] = ent[0]
         # Durability feedback (pipelined mode): the fsynced tail per
         # group — every completed host phase ends with its fsync barrier,
         # so the mirror is durable by construction at dispatch time.  The
@@ -966,7 +1012,11 @@ class RaftNode:
             snap_done=jnp.asarray(snap_done),
             snap_idx=jnp.asarray(snap_idx),
             snap_term=jnp.asarray(snap_term),
+            snap_conf=jnp.asarray(snap_conf),
             compact_to=jnp.asarray(self._compact_grant.astype(np.int32)),
+            conf_voters=jnp.asarray(conf_voters),
+            conf_learners=jnp.asarray(conf_learners),
+            xfer_target=jnp.asarray(xfer_target),
             read_n=jnp.asarray(read_n),
             read_veto=jnp.asarray(read_veto),
             durable_tail=durable,
@@ -1055,6 +1105,10 @@ class RaftNode:
             # (RELEASED) stay — a confirmed ReadIndex remains a valid
             # linearization point under any later leadership.
             self._reject_reads(g)
+
+        # Membership plane: refresh config mirrors, settle pending
+        # change/transfer futures, fold the tick's counters.
+        self._harvest_membership(h_info, h_role)
 
         # -- flight-recorder drain -------------------------------------------
         # Opt-in with the recorder itself: decoded events feed per-group
@@ -1243,11 +1297,15 @@ class RaftNode:
             fr_start = (inbox_arrays["ae_prev_idx"][src_clip, wrote]
                         + 1).tolist()
             fr_ents = inbox_arrays["ae_ents"]
+            fr_cents = inbox_arrays.get("ae_cents")
         else:
             fr_valid = [False] * len(wrote_l)
             fr_n = [0] * len(wrote_l)
             fr_start = [0] * len(wrote_l)
             fr_ents = None
+            fr_cents = None
+        put_conf = getattr(self.store, "put_conf", None)
+        conf_overwrite = getattr(self.store, "conf_overwrite", None)
         for j, g in enumerate(wrote_l):
             lo, hi = lo_l[j], hi_l[j]
             n_sub = nsub_l[j]
@@ -1281,6 +1339,21 @@ class RaftNode:
                     terms = fr_ents[leader_src, g, koff:koff + cnt]
                     spans.append((g, lo, run.piece(k, cnt),
                                   run.lens[k:k + cnt], terms))
+                    # The membership sidecar mirrors the WAL's overwrite
+                    # semantics: an adoption span at `lo` kills every
+                    # durable entry at >= lo (a conflicting AE can
+                    # overwrite a recorded config entry with ORDINARY
+                    # entries — the sidecar record must die with it, or
+                    # recovery resurrects a dead voter set), then the
+                    # span's own config entries (nonzero conf words in
+                    # the frame) are re-recorded for the durable range.
+                    if conf_overwrite is not None:
+                        conf_overwrite(g, lo)
+                    if put_conf is not None and fr_cents is not None:
+                        cw = fr_cents[leader_src, g, koff:koff + cnt]
+                        if cw.any():
+                            for kk in np.nonzero(cw)[0].tolist():
+                                put_conf(g, lo + kk, int(cw[kk]))
                 gap = end_cov < adopt_hi
             if n_sub and not gap and hi >= sub_lo:
                 # Own accepted submissions, all at our term: slice the
@@ -1310,6 +1383,21 @@ class RaftNode:
                     f"device-accepted own submissions at {sub_lo} — "
                     "kernel phase order makes adopt+accept in one tick "
                     "impossible")
+        # Config entries this node appended as leader (§6 intake accept or
+        # the automatic joint leave): staged durably with an EMPTY payload
+        # like the §8 no-op — appended AFTER the per-group spans above, so
+        # WAL replay order matches index order (a conf entry's index is
+        # the tick's highest) — plus the sidecar record recovery rebuilds
+        # the conf ring from.
+        conf_app = np.asarray(info.conf_app_idx)
+        if (conf_app > 0).any():
+            conf_term = np.asarray(info.conf_app_term)
+            conf_word = np.asarray(info.conf_app_word)
+            for g in np.nonzero(conf_app > 0)[0].tolist():
+                spans.append((int(g), int(conf_app[g]), b"",
+                              _NOOP_LENS, int(conf_term[g])))
+                if put_conf is not None:
+                    put_conf(int(g), int(conf_app[g]), int(conf_word[g]))
         if spans:
             append_spans = getattr(self.store, "append_spans", None)
             if append_spans is not None:
@@ -1485,6 +1573,200 @@ class RaftNode:
             b.sink._fail(err)
         self.metrics["read_batches_aborted"] += len(batches)
 
+    # ------------------------------------------------------------ membership
+
+    def change_membership(self, group: int, voters: int,
+                          learners: int = 0) -> Future:
+        """Reconfigure one group to the TARGET config (§6 joint
+        consensus): ``voters``/``learners`` are peer-slot bitmasks.  A
+        voter-set change walks C_old -> C_old,new -> C_new through the
+        log (the leave entry auto-appends when the joint entry commits);
+        a learner-only change is a single entry.  The future resolves —
+        with the decoded config — once the FINAL config is active and
+        committed, or fails with NotLeader on leadership loss (marked
+        retry-safe only if the change provably never entered the log).
+        One change in flight per group, here AND on the device."""
+        from ..core.types import conf_pack
+
+        fut: Future = Future()
+        P = self.cfg.n_peers
+        full = (1 << P) - 1
+        voters = int(voters)
+        learners = int(learners) & ~voters
+        if not (0 < voters <= full) or not (0 <= learners <= full) \
+                or (voters | learners) > full:
+            fut.set_exception(ValueError(
+                f"bad membership masks for P={P}: voters={voters:#x} "
+                f"learners={learners:#x}"))
+            return fut
+        err = self._refusal(group)
+        if err is not None:
+            fut.set_exception(err)
+            return fut
+        final = int(conf_pack(voters, 0, learners))
+        with self._member_lock:
+            if group in self._conf_pending:
+                fut.set_exception(as_refusal(BusyLoopError(
+                    f"group {group}: a membership change is already "
+                    "pending")))
+                return fut
+            if int(self.h_conf_word[group]) == final \
+                    and not self.h_conf_pending[group]:
+                # Already the active committed config: resolve like the
+                # settled path would.
+                fut.set_result({"voters": voters, "learners": learners})
+                return fut
+            self._conf_pending[group] = [voters, learners, fut, False]
+        return fut
+
+    def transfer_leadership(self, group: int, target: int) -> Future:
+        """Hand leadership of ``group`` to voter ``target`` (§3.10
+        TimeoutNow): fence submissions, wait for the target's match to
+        cover the log end, tell it to campaign.  Resolves with the
+        target id once this node observes its own step-down after the
+        TimeoutNow went out; fails (retry-safe) if the transfer aborts —
+        deadline, target not a voter, leadership lost first."""
+        from ..core.types import conf_new_of, conf_voters_of
+
+        fut: Future = Future()
+        target = int(target)
+        err = self._refusal(group)
+        if err is not None:
+            fut.set_exception(err)
+            return fut
+        w = int(self.h_conf_word[group])
+        if not (0 <= target < self.cfg.n_peers) \
+                or target == self.node_id \
+                or not ((conf_voters_of(w) | conf_new_of(w))
+                        >> target) & 1:
+            # The device intake only latches VOTER targets; refusing here
+            # keeps a learner/removed-slot request from pending forever.
+            fut.set_exception(as_refusal(ValueError(
+                f"transfer target {target} is not a voter of group "
+                f"{group}")))
+            return fut
+        with self._member_lock:
+            if group in self._xfer_pending:
+                fut.set_exception(as_refusal(BusyLoopError(
+                    f"group {group}: a leadership transfer is already "
+                    "pending")))
+                return fut
+            # TTL covers the never-latched case (the config changed under
+            # us, the device keeps refusing intake): the device's own
+            # deadline only starts once a transfer latches.
+            ttl = 6 * self.cfg.election_ticks + 20
+            self._xfer_pending[group] = [target, fut, False, ttl]
+        self.metrics["leadership_transfers_attempted"] += 1
+        return fut
+
+    def membership(self, group: int) -> dict:
+        """Decoded active config of one group (device mirror)."""
+        from ..core.types import (
+            conf_learners_of, conf_new_of, conf_voters_of,
+        )
+
+        w = int(self.h_conf_word[group])
+        return {
+            "voters": int(conf_voters_of(w)),
+            "voters_new": int(conf_new_of(w)),
+            "learners": int(conf_learners_of(w)),
+            "joint": bool(conf_new_of(w)),
+            "pending": bool(self.h_conf_pending[group]),
+            "conf_idx": int(self.h_conf_idx[group]),
+        }
+
+    def catch_up_gap(self, group: int, peer: int) -> int:
+        """Leader-side replication lag of one peer: ``last - match``
+        (0 = fully caught up).  An admin-cadence device read — the
+        rebalancer polls it to decide when a learner is promotable."""
+        import jax
+
+        last, match = jax.device_get(
+            (self.state.log.last[group],
+             self.state.match_idx[group, peer]))
+        return max(0, int(last) - int(match))
+
+    def _harvest_membership(self, info: StepInfo, h_role) -> None:
+        """Tick thread: refresh config mirrors from StepInfo, resolve
+        pending change/transfer futures, fold membership counters."""
+        from ..core.types import conf_pack
+
+        conf_word = np.asarray(info.conf_word)
+        conf_idx = np.asarray(info.conf_idx)
+        conf_pending = np.asarray(info.conf_pending)
+        app_idx = np.asarray(info.conf_app_idx)
+        fired = np.asarray(info.xfer_fired)
+        x_abort = np.asarray(info.xfer_abort)
+        m = self.metrics
+        m["membership_changes_entered"] += int((app_idx > 0).sum())
+        # A config entry COMMITTED when its pending flag clears at the
+        # same entry index (a truncation rollback changes the index too
+        # and must not count).
+        m["membership_changes_committed"] += int(
+            (self.h_conf_pending & ~conf_pending
+             & (self.h_conf_idx == conf_idx) & (conf_idx > 0)).sum())
+        m["timeout_now_sent"] += int(fired.sum())
+        self.h_conf_word = conf_word
+        self.h_conf_idx = conf_idx
+        self.h_conf_pending = conf_pending
+        settled: List[Tuple[Future, Optional[Exception], object]] = []
+        with self._member_lock:
+            for g, ent in list(self._conf_pending.items()):
+                tv, tl, fut, accepted = ent
+                if app_idx[g] > 0:
+                    ent[3] = accepted = True
+                final = int(conf_pack(tv, 0, tl))
+                if int(conf_word[g]) == final and not conf_pending[g]:
+                    del self._conf_pending[g]
+                    settled.append((fut, None, {
+                        "voters": tv, "learners": tl}))
+                elif h_role[g] != LEADER:
+                    del self._conf_pending[g]
+                    err = NotLeaderError(g, self.leader_hint(g))
+                    # Never accepted into the log -> marked retry-safe
+                    # refusal; accepted -> unmarked (the change may still
+                    # commit under the new leader).
+                    settled.append((fut,
+                                    err if accepted else as_refusal(err),
+                                    None))
+                    m["membership_changes_aborted"] += 1
+            for g, ent in list(self._xfer_pending.items()):
+                tgt, fut, was_fired, ttl = ent
+                if fired[g]:
+                    ent[2] = was_fired = True
+                ent[3] = ttl = ttl - 1
+                if h_role[g] != LEADER and was_fired:
+                    # Relinquished after TimeoutNow: the transfer
+                    # succeeded (the target campaigns with a complete
+                    # log; the leader hint converges to it).
+                    del self._xfer_pending[g]
+                    settled.append((fut, None, tgt))
+                    m["leadership_transfers_succeeded"] += 1
+                elif h_role[g] != LEADER or x_abort[g] or ttl <= 0:
+                    del self._xfer_pending[g]
+                    settled.append((fut, as_refusal(NotLeaderError(
+                        g, self.leader_hint(g))), None))
+                    m["leadership_transfers_aborted"] += 1
+        for fut, err, res in settled:
+            if fut.done():
+                continue
+            if err is None:
+                fut.set_result(res)
+            else:
+                fut.set_exception(err)
+
+    def _reject_membership(self, g: int, exc: Exception) -> None:
+        """Fail pending membership ops for a closing/destroyed lane."""
+        with self._member_lock:
+            ent = self._conf_pending.pop(g, None)
+            xent = self._xfer_pending.pop(g, None)
+        if ent is not None and not ent[2].done():
+            ent[2].set_exception(as_refusal(exc))
+            self.metrics["membership_changes_aborted"] += 1
+        if xent is not None and not xent[1].done():
+            xent[1].set_exception(as_refusal(exc))
+            self.metrics["leadership_transfers_aborted"] += 1
+
     def _purge_lanes(self, lanes: List[int]) -> None:
         """Wipe destroyed lanes end to end: durable WAL state, machine,
         archived snapshots, and every device-side lane (term, log, vote,
@@ -1537,8 +1819,11 @@ class RaftNode:
             applied=s.applied.at[idx].set(0),
             log=s.log.replace(
                 term=s.log.term.at[idx].set(0),
+                conf=s.log.conf.at[idx].set(0),
                 base=s.log.base.at[idx].set(0),
                 base_term=s.log.base_term.at[idx].set(0),
+                base_conf=s.log.base_conf.at[idx].set(
+                    _boot_conf_word(self.cfg)),
                 last=s.log.last.at[idx].set(0)),
             next_idx=s.next_idx.at[idx].set(1),
             match_idx=s.match_idx.at[idx].set(0),
@@ -1559,6 +1844,10 @@ class RaftNode:
             rq_n=s.rq_n.at[idx].set(0),
             rq_head=s.rq_head.at[idx].set(0),
             rq_len=s.rq_len.at[idx].set(0),
+            conf_idx=s.conf_idx.at[idx].set(0),
+            conf_word=s.conf_word.at[idx].set(_boot_conf_word(self.cfg)),
+            xfer_to=s.xfer_to.at[idx].set(NIL),
+            xfer_dl=s.xfer_dl.at[idx].set(0),
             trace=(s.trace.replace(
                 tick=s.trace.tick.at[idx].set(0),
                 kind=s.trace.kind.at[idx].set(0),
@@ -1580,6 +1869,18 @@ class RaftNode:
         self._durable_tail_m[np.asarray(lanes)] = 0
         self._stable_term_m[np.asarray(lanes)] = -2
         self._stable_voted_m[np.asarray(lanes)] = -2
+        hcw = np.array(self.h_conf_word)
+        hci = np.array(self.h_conf_idx)
+        hcp = np.array(self.h_conf_pending)
+        hcw[np.asarray(lanes)] = _boot_conf_word(self.cfg)
+        hci[np.asarray(lanes)] = 0
+        hcp[np.asarray(lanes)] = False
+        self.h_conf_word, self.h_conf_idx = hcw, hci
+        self.h_conf_pending = hcp
+        for g in lanes:
+            self._snap_conf.pop(g, None)
+            self._reject_membership(
+                g, ObsoleteContextError(f"group {g} destroyed"))
 
     def _payload(self, g: int, idx: int) -> Optional[bytes]:
         return self.store.payload(g, idx)
@@ -1769,6 +2070,10 @@ class RaftNode:
             peer = int(np.asarray(info.snap_req_from)[g])
             if self.archive.pend_snapshot(g, idx, term, peer) is None:
                 continue
+            # The offer's config word (is_conf) rides to install time: it
+            # becomes the installer's base_conf via HostInbox.snap_conf.
+            self._snap_conf[g] = (idx,
+                                  int(np.asarray(info.snap_req_conf)[g]))
             with self._snap_cv:
                 self._snap_inflight.add(g)
                 self._snap_queue.append(
@@ -1867,16 +2172,27 @@ class RaftNode:
                 snap = self.archive.install_pending(g, tmp, got_idx, got_term)
                 self.dispatcher.resume_from(
                     g, Checkpoint(path=snap.path, index=snap.index))
+                # The offered config applies only if the downloaded
+                # snapshot IS the offered milestone (the server may have
+                # rotated to a newer one, whose config we do not know —
+                # then base_conf stays and AE adoption corrects it).
+                pend = self._snap_conf.pop(g, None)
+                cw = pend[1] if pend is not None \
+                    and pend[0] == snap.index else 0
                 # Durable milestone before the device adopts it (the stable-
                 # record rule for snapshots, support/StableLock.java:82-91).
-                self.store.set_floor(g, snap.index, snap.term)
+                if getattr(self.store, "put_conf", None) is not None:
+                    self.store.set_floor(g, snap.index, snap.term,
+                                         conf_word=cw)
+                else:
+                    self.store.set_floor(g, snap.index, snap.term)
                 self._wal_floor[g] = max(self._wal_floor[g], snap.index)
                 self._durable_tail_m[g] = max(self._durable_tail_m[g],
                                               snap.index)
                 self.store.sync()
                 self.maintain.note_checkpoint(g, self.ticks, snap.index)
                 self.metrics["snapshots_installed"] += 1
-                done.append((g, snap.index, snap.term))
+                done.append((g, snap.index, snap.term, cw))
             except Exception:
                 log.exception("snapshot install failed g=%d", g)
                 self.archive.clear_pending(g)

@@ -97,14 +97,16 @@ class LocalCluster:
                                     submit_handler=node.submit,
                                     result_encoder=node.serializer
                                     .encode_result,
-                                    read_handler=node.read)
+                                    read_handler=node.read,
+                                    conf_node=node)
             return LoopbackTransport(self.net, node_id, self.cfg,
                                      node.template, on_slice,
                                      snapshot_provider,
                                      submit_handler=node.submit,
                                      result_encoder=node.serializer
                                      .encode_result,
-                                     read_handler=node.read)
+                                     read_handler=node.read,
+                                     conf_node=node)
         return build
 
     def start_node(self, i: int) -> RaftNode:

@@ -28,10 +28,28 @@ import numpy as np
 from ..core.types import (
     CANDIDATE, FOLLOWER, LEADER, NIL, PRE_CANDIDATE,
     TR_BECAME_CANDIDATE, TR_BECAME_LEADER, TR_BECAME_PRE_CANDIDATE,
-    TR_COMMIT_ADVANCE, TR_READ_RELEASE, TR_SNAPSHOT_INSTALL,
+    TR_COMMIT_ADVANCE, TR_CONF_CHANGE_COMMIT, TR_CONF_CHANGE_ENTER,
+    TR_LEADER_TRANSFER, TR_READ_RELEASE, TR_SNAPSHOT_INSTALL,
     TR_STEPPED_DOWN, TR_TERM_BUMP,
     EngineConfig, HostInbox, Messages, RaftState,
+    conf_learners_of, conf_new_of, conf_pack, conf_voters_of,
 )
+
+
+def _popcount(x: int) -> int:
+    return bin(x & 0xFFFFFFFF).count("1")
+
+
+def _dual_quorum(flags, voters: int, voters_new: int) -> bool:
+    """Scalar mirror of core.step.dual_quorum: ``flags`` is a per-peer
+    boolean sequence; a joint config needs a majority in BOTH sets."""
+    cv = sum(1 for p, f in enumerate(flags) if f and (voters >> p) & 1)
+    ok = cv >= _popcount(voters) // 2 + 1
+    if voters_new:
+        cn = sum(1 for p, f in enumerate(flags)
+                 if f and (voters_new >> p) & 1)
+        ok = ok and cn >= _popcount(voters_new) // 2 + 1
+    return ok
 
 
 def _np(tree) -> Dict[str, np.ndarray]:
@@ -56,8 +74,10 @@ def _np(tree) -> Dict[str, np.ndarray]:
 class _Log:
     """Scalar view of one group's log ring."""
     ring: np.ndarray  # [L] terms
+    cring: np.ndarray  # [L] packed config words (0 = not a config entry)
     base: int
     base_term: int
+    base_conf: int
     last: int
 
     def term_at(self, idx: int) -> int:
@@ -67,6 +87,25 @@ class _Log:
         if idx <= self.last:
             return int(self.ring[idx % len(self.ring)])
         return -1
+
+    def conf_at(self, idx: int) -> int:
+        # Mirrors ring_conf_batch: the entry's packed config word inside
+        # the live window, else 0.
+        if self.base < idx <= self.last:
+            return int(self.cring[idx % len(self.ring)])
+        return 0
+
+    def latest_conf(self, upto: int):
+        """(conf_idx, conf_word) of the latest config entry in
+        (base, min(upto, last)], else (0, base_conf) — the scalar mirror
+        of core.step.latest_conf."""
+        L = len(self.ring)
+        lo = max(self.base + 1, self.last - L + 1, 1)
+        for idx in range(min(upto, self.last), lo - 1, -1):
+            w = int(self.cring[idx % L])
+            if w != 0:
+                return idx, w
+        return 0, int(self.base_conf)
 
 
 def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
@@ -100,9 +139,15 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     leader_id = s["leader_id"].copy()
     commit = s["commit"].copy()
     ring = s["log.term"].copy()
+    cring = s["log.conf"].copy()
     base = s["log.base"].copy()
     base_term = s["log.base_term"].copy()
+    base_conf = s["log.base_conf"].copy()
     last = s["log.last"].copy()
+    conf_idx_st = s["conf_idx"].copy()
+    conf_word_st = s["conf_word"].copy()
+    xfer_to = s["xfer_to"].copy()
+    xfer_dl = s["xfer_dl"].copy()
     next_idx = s["next_idx"].copy()
     own_from_a = s["own_from"].astype(np.int64).copy()
     match_idx = s["match_idx"].copy()
@@ -153,7 +198,8 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     out = {
         "ae_valid": zb(P, G), "ae_term": zi(P, G), "ae_prev_idx": zi(P, G),
         "ae_prev_term": zi(P, G), "ae_commit": zi(P, G), "ae_n": zi(P, G),
-        "ae_ents": zi(P, G, B), "ae_occ": zb(P, G), "ae_tick": zi(P, G),
+        "ae_ents": zi(P, G, B), "ae_cents": zi(P, G, B),
+        "ae_occ": zb(P, G), "ae_tick": zi(P, G),
         "aer_valid": zb(P, G), "aer_term": zi(P, G),
         "aer_success": zb(P, G), "aer_match": zi(P, G),
         "aer_empty": zb(P, G), "aer_occ": zb(P, G), "aer_tick": zi(P, G),
@@ -162,9 +208,10 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         "rvr_valid": zb(P, G), "rvr_term": zi(P, G), "rvr_granted": zb(P, G),
         "rvr_prevote": zb(P, G), "rvr_echo": zi(P, G),
         "is_valid": zb(P, G), "is_term": zi(P, G), "is_idx": zi(P, G),
-        "is_last_term": zi(P, G), "is_probe": zb(P, G),
+        "is_last_term": zi(P, G), "is_probe": zb(P, G), "is_conf": zi(P, G),
         "isr_valid": zb(P, G), "isr_term": zi(P, G), "isr_success": zb(P, G),
         "isr_probe": zb(P, G),
+        "tn_valid": zb(P, G), "tn_term": zi(P, G),
     }
     info = {
         "submit_start": zi(G), "submit_acc": zi(G), "dirty": zb(G),
@@ -172,16 +219,28 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         "commit": zi(G), "leader": np.full(G, NIL, np.int32),
         "ready": zb(G),
         "snap_req": zb(G), "snap_req_from": zi(G), "snap_req_idx": zi(G),
-        "snap_req_term": zi(G),
+        "snap_req_term": zi(G), "snap_req_conf": zi(G),
         "noop_idx": zi(G), "noop_term": zi(G),
         "read_acc": zi(G), "read_index": zi(G),
         "read_rel": zi(G), "read_served": zi(G),
         "read_lease": zb(G), "read_abort": zb(G),
+        "conf_app_idx": zi(G), "conf_app_term": zi(G),
+        "conf_app_word": zi(G),
+        "conf_word": zi(G), "conf_idx": zi(G), "conf_pending": zb(G),
+        "xfer_fired": zb(G), "xfer_abort": zb(G),
     }
 
     for g in range(G):
-        log = _Log(ring[g], int(base[g]), int(base_term[g]), int(last[g]))
+        log = _Log(ring[g], cring[g], int(base[g]), int(base_term[g]),
+                   int(base_conf[g]), int(last[g]))
         app_from, app_to = 0, 0
+
+        # ---- 0. membership view C0 (tick-start) ---------------------------
+        # (kernel phase 0: the state's conf_idx/conf_word cache — always
+        # equal to the latest config entry in the log, §6 apply-on-append;
+        # tallies count against it.)
+        cidx0, w0 = int(conf_idx_st[g]), int(conf_word_st[g])
+        voters0, vnew0 = conf_voters_of(w0), conf_new_of(w0)
 
         # ---- 1. term sync: adopt the highest real inbound term ------------
         # (Raft "if RPC term > currentTerm, become follower"; reference
@@ -201,6 +260,8 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
                 mt = max(mt, int(ib["is_term"][p, g]))
             if ib["isr_valid"][p, g]:
                 mt = max(mt, int(ib["isr_term"][p, g]))
+            if ib["tn_valid"][p, g]:
+                mt = max(mt, int(ib["tn_term"][p, g]))
         if active[g] and mt > term[g]:
             term[g] = mt
             role[g] = FOLLOWER
@@ -259,7 +320,7 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
                     and int(ib["rvr_term"][p, g]) == term[g]):
                 votes[g, p] = True
         become_cand_pv = (role[g] == PRE_CANDIDATE
-                          and prevotes[g].sum() >= maj)
+                          and _dual_quorum(prevotes[g], voters0, vnew0))
         if become_cand_pv:
             term[g] += 1
             role[g] = CANDIDATE
@@ -268,7 +329,8 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
             votes[g] = False
             votes[g, me] = True
             elect_dl[g] = now + rand_to[g]
-        vote_win = role[g] == CANDIDATE and votes[g].sum() >= maj
+        vote_win = (role[g] == CANDIDATE
+                    and _dual_quorum(votes[g], voters0, vnew0))
         if vote_win:
             role[g] = LEADER
             leader_id[g] = me
@@ -290,6 +352,7 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
                 info["noop_idx"][g] = log.last + 1
                 info["noop_term"][g] = term[g]
                 log.ring[(log.last + 1) % L] = term[g]
+                log.cring[(log.last + 1) % L] = 0
                 log.last += 1
 
         # ---- 4. AppendEntries requests ------------------------------------
@@ -312,6 +375,7 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
             n_e = max(0, min(n_e, log.base + L - prev_i))
             lc = int(ib["ae_commit"][ae_peer, g])
             ents = ib["ae_ents"][ae_peer, g]
+            centsv = ib["ae_cents"][ae_peer, g]
             acc = (prev_i <= log.base
                    or (prev_i <= log.last and log.term_at(prev_i) == prev_t))
             if acc:
@@ -327,6 +391,9 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
                     idx = prev_i + 1 + k
                     if idx > log.base:
                         log.ring[idx % L] = ents[k]
+                        # Config adoption rides the entry write (§6
+                        # apply-on-append via latest_conf).
+                        log.cring[idx % L] = centsv[k]
                 new_last = tail if conflict else max(log.last, tail)
                 wrote = n_e > 0 and (new_last != log.last or conflict)
                 if wrote:
@@ -363,6 +430,7 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         # impossible schedule — a same-term leader; matches the kernel).
         off_idx = int(ib["is_idx"][is_peer, g])
         off_term = int(ib["is_last_term"][is_peer, g])
+        off_conf = int(ib["is_conf"][is_peer, g])
         covered = (any(is_ok)
                    and (off_idx <= log.base
                         or (off_idx <= log.last
@@ -376,6 +444,7 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
                 info["snap_req_from"][g] = is_peer
                 info["snap_req_idx"][g] = off_idx
                 info["snap_req_term"][g] = off_term
+                info["snap_req_conf"][g] = off_conf
         for p in range(P):
             if bool(ib["is_valid"][p, g]) and active[g] and p != me:
                 out["isr_valid"][p, g] = True
@@ -390,6 +459,8 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
             si, st = int(h["snap_idx"][g]), int(h["snap_term"][g])
             tail_matches = si <= log.last and log.term_at(si) == st
             log.base, log.base_term = si, st
+            if int(h["snap_conf"][g]) != 0:
+                log.base_conf = int(h["snap_conf"][g])
             if not tail_matches:
                 log.last = si
             commit[g] = max(commit[g], si)
@@ -397,7 +468,16 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         ct = min(int(h["compact_to"][g]), int(commit[g]))
         if active[g] and ct > log.base:
             log.base_term = log.term_at(ct)
+            # The milestone config folds into base_conf BEFORE the floor
+            # moves (kernel: latest_conf(log, ct) pre-floor).
+            _, log.base_conf = log.latest_conf(ct)
             log.base = ct
+
+        # Membership view C1 (kernel: post-AE/snapshot/compaction).
+        cidx1, w1 = log.latest_conf(log.last)
+        voters1, vnew1 = conf_voters_of(w1), conf_new_of(w1)
+        lrn1 = conf_learners_of(w1)
+        voter_self = ((voters1 | vnew1) >> me) & 1
 
         # ---- 6. AppendEntries / snapshot responses (leader side) ----------
         # (reference Leader.java:224-243, Leadership.updateIndex:75-114;
@@ -474,7 +554,9 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         # (reference Follower.onTimeout:156-168, Candidate.onTimeout:82-88.)
         start_pre = False
         timer_cand = False
-        if active[g] and now >= elect_dl[g] and role[g] != LEADER:
+        # Only voters campaign (§6; kernel phase 7 gate on C1).
+        if (active[g] and now >= elect_dl[g] and role[g] != LEADER
+                and voter_self):
             if cfg.pre_vote:
                 if role[g] in (FOLLOWER, PRE_CANDIDATE):
                     start_pre = True
@@ -482,6 +564,14 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
                     timer_cand = True
             else:
                 timer_cand = True
+        # TimeoutNow (§3.10): immediate candidacy, skipping PreVote.
+        tn_cand = (active[g] and role[g] != LEADER and voter_self
+                   and any(ib["tn_valid"][p, g]
+                           and int(ib["tn_term"][p, g]) == term[g]
+                           for p in range(P) if p != me))
+        if tn_cand:
+            start_pre = False
+            timer_cand = True
         if timer_cand:
             term[g] += 1
             voted[g] = me
@@ -499,11 +589,27 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         became_cand = become_cand_pv or timer_cand
         last_term_v = log.term_at(log.last)
 
+        # ---- 7b. leadership-transfer intake/abort (kernel phase 7b) -------
+        pend0 = int(xfer_to[g]) != NIL
+        keep_x = (pend0 and active[g] and role[g] == LEADER
+                  and term[g] == old_term[g] and now < int(xfer_dl[g]))
+        info["xfer_abort"][g] = pend0 and not keep_x
+        if not keep_x:
+            xfer_to[g], xfer_dl[g] = NIL, 0
+        tgt = int(h["xfer_target"][g])
+        tgt_voter = 0 <= tgt < P and ((voters1 | vnew1) >> tgt) & 1
+        if (active[g] and role[g] == LEADER and int(xfer_to[g]) == NIL
+                and tgt_voter and tgt != me):
+            xfer_to[g] = tgt
+            xfer_dl[g] = now + cfg.election_ticks
+        fenced = int(xfer_to[g]) != NIL
+
         # ---- 8. client submissions ----------------------------------------
-        # (reference RaftStub.submit -> Leader.acceptCommand:128-140.)
+        # (reference RaftStub.submit -> Leader.acceptCommand:128-140; a
+        # pending leadership transfer fences intake.)
         info["submit_start"][g] = log.last + 1
         n_acc = 0
-        if active[g] and role[g] == LEADER:
+        if active[g] and role[g] == LEADER and not fenced:
             free = L - (log.last - log.base)
             n_acc = max(0, min(int(h["submit_n"][g]), min(free, S)))
         if n_acc > 0:
@@ -511,6 +617,7 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
                 app_from = log.last + 1
             for k in range(n_acc):
                 log.ring[(log.last + 1 + k) % L] = term[g]
+                log.cring[(log.last + 1 + k) % L] = 0
             log.last += n_acc
             app_to = log.last
         info["submit_acc"][g] = n_acc
@@ -541,9 +648,9 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         n_rel, n_served = 0, 0
         for j in range(int(rq_len[g])):
             slot = (int(rq_head[g]) + j) % K
-            cnt = 1 + sum(int(read_evid[g, p]) >= int(rq_stamp[g, slot])
-                          for p in range(P))
-            if cnt < maj:
+            flags = [p == me or int(read_evid[g, p]) >= int(rq_stamp[g, slot])
+                     for p in range(P)]
+            if not _dual_quorum(flags, voters1, vnew1):
                 break   # FIFO: an unreleasable batch blocks younger ones
             n_rel += 1
             n_served += int(rq_n[g, slot])
@@ -555,13 +662,51 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
                                  and int(rq_len[g]) == 0)
         read_kick = n_read > 0 and int(rq_len[g]) > 0
 
+        # ---- 8c. membership-change intake + automatic joint leave ---------
+        # (kernel phase 8c: one config entry per request — joint when the
+        # voter set moves — one change in flight, C_new leave appended
+        # automatically once the joint entry commits.)
+        full_bits = (1 << P) - 1
+        hv = int(h["conf_voters"][g]) & full_bits
+        hl = int(h["conf_learners"][g]) & full_bits & ~hv
+        joint1 = vnew1 != 0
+        pending1 = cidx1 > commit[g]
+        may_append = (active[g] and role[g] == LEADER and not pending1
+                      and log.last - log.base < L)
+        enter_word = int(conf_pack(voters1, 0, hl) if hv == voters1
+                         else conf_pack(voters1, hv, hl))
+        want_enter = (may_append and not joint1 and not fenced
+                      and hv != 0 and enter_word != w1)
+        want_leave = may_append and joint1
+        conf_app = want_enter or want_leave
+        app_word = int(conf_pack(vnew1, 0, lrn1)) if want_leave \
+            else enter_word
+        if conf_app:
+            nidx = log.last + 1
+            log.ring[nidx % L] = term[g]
+            log.cring[nidx % L] = app_word
+            log.last = nidx
+            info["conf_app_idx"][g] = nidx
+            info["conf_app_term"][g] = term[g]
+            info["conf_app_word"][g] = app_word
+            if app_from == 0:
+                app_from = nidx
+            app_to = log.last
+            cidx2, w2 = nidx, app_word
+        else:
+            cidx2, w2 = cidx1, w1
+        voters2, vnew2 = conf_voters_of(w2), conf_new_of(w2)
+        lrn2 = conf_learners_of(w2)
+        member2 = voters2 | vnew2 | lrn2
+
         # ---- 9. replication fan-out ---------------------------------------
         # (reference Leader.replicateLog:142-245 + prepareElection fan-out;
-        # pipelined up to inflight_limit batches, Leadership.java:10-11.)
+        # pipelined up to inflight_limit batches, Leadership.java:10-11;
+        # fan-out gated to MEMBER slots of the active config.)
         heartbeat = role[g] == LEADER and (now >= hb_due[g] or read_kick)
         if active[g] and role[g] == LEADER:
             for p in range(P):
-                if p == me:
+                if p == me or not (member2 >> p) & 1:
                     continue
                 # RPC timeout — the only failure evidence, anchored to our
                 # own last occupying send (see kernel phase 9; reference
@@ -608,6 +753,7 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
                             log.base_term if idx <= log.base
                             else (log.ring[idx % L] if idx <= log.last
                                   else -1))
+                        out["ae_cents"][p, g, k] = log.conf_at(idx)
                     send_next[g, p] += n_send
                 elif send_is:
                     out["is_valid"][p, g] = True
@@ -615,6 +761,7 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
                     out["is_idx"][p, g] = log.base
                     out["is_last_term"][p, g] = log.base_term
                     out["is_probe"][p, g] = not send_is_win
+                    out["is_conf"][p, g] = log.base_conf
                 # Data batches and first snapshot offers occupy data
                 # slots, in-window heartbeats occupy heartbeat slots; any
                 # occupying send refreshes the send clock.
@@ -627,24 +774,38 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         if heartbeat:
             hb_due[g] = now + cfg.heartbeat_ticks
 
-        # Leader readiness (reference Leader.isReady, Leader.java:52-64).
-        n_healthy = 0
+        # Leader readiness (reference Leader.isReady, Leader.java:52-64),
+        # as a masked quorum over the active config; self counts iff self
+        # is a voter; a pending transfer reports not-ready.
+        flags = []
         for p in range(P):
             if p == me:
+                flags.append(True)
                 continue
             hp = (active[g] and role[g] == LEADER
+                  and bool((member2 >> p) & 1)
                   and ok_at[g, p] > 0 and not need_snap[g, p])
             if cfg.avail_crit > 0:
                 hp = hp and fail_streak[g, p] <= cfg.avail_crit
             if cfg.recovery_ticks > 0:
                 hp = hp and (fail_at[g, p] == 0
                              or now - fail_at[g, p] >= cfg.recovery_ticks)
-            n_healthy += int(hp)
-        info["ready"][g] = (active[g] and role[g] == LEADER
-                            and 1 + n_healthy >= maj)
+            flags.append(bool(hp))
+        info["ready"][g] = (active[g] and role[g] == LEADER and not fenced
+                            and _dual_quorum(flags, voters2, vnew2))
+
+        # TimeoutNow dispatch (kernel: after readiness, pre-commit match).
+        xt = int(xfer_to[g])
+        fire = (active[g] and role[g] == LEADER and xt != NIL
+                and int(match_idx[g, xt]) >= log.last)
+        info["xfer_fired"][g] = fire
+        if fire:
+            out["tn_valid"][xt, g] = True
+            out["tn_term"][xt, g] = term[g]
+
         if active[g] and (became_cand or start_pre):
             for p in range(P):
-                if p == me:
+                if p == me or not ((voters2 | vnew2) >> p) & 1:
                     continue
                 out["rv_valid"][p, g] = True
                 out["rv_term"][p, g] = term[g] + 1 if start_pre else term[g]
@@ -657,8 +818,23 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         # Leader.tryCommit:256-261.)
         full = match_idx[g].copy()
         full[me] = log.last
-        quorum_idx = int(np.sort(full)[P - maj])
-        full_idx = int(full.min())
+
+        def _stat(mask: int) -> int:
+            # ops/quorum.masked_order_stat, scalar: non-members sort as
+            # -1 below every real match; the statistic sits at
+            # P - (popcount//2 + 1) of the ascending order.
+            vals = sorted(int(full[p]) if (mask >> p) & 1 else -1
+                          for p in range(P))
+            pos = min(max(P - (_popcount(mask) // 2 + 1), 0), P - 1)
+            return vals[pos]
+
+        quorum_idx = _stat(voters2)
+        if vnew2:
+            # Joint config: a commit needs a quorum in BOTH sets (§6).
+            quorum_idx = min(quorum_idx, _stat(vnew2))
+        voter_rows = [int(full[p]) for p in range(P)
+                      if ((voters2 | vnew2) >> p) & 1]
+        full_idx = min(voter_rows) if voter_rows else (1 << 31) - 1
         # Own-term rule via own_from (terms monotone along the log; set at
         # election win) — mirrors ops/quorum.py exactly.
         if (active[g] and role[g] == LEADER and quorum_idx > commit[g]
@@ -666,13 +842,26 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
                 and quorum_idx <= log.last):
             commit[g] = quorum_idx
         # Full-replication lane (reference Leader.java:260, mirrors
-        # ops/quorum.py): min of the match row commits without the
-        # own-term fence — identical on every node, hence on every
-        # possible future leader.
+        # ops/quorum.py): min over VOTER slots commits without the
+        # own-term fence — identical on every voter, hence on every
+        # possible future leader; learner lag never stalls it.
         if (active[g] and role[g] == LEADER and full_idx > commit[g]
                 and full_idx <= log.last):
             commit[g] = full_idx
         match_idx[g] = full
+
+        # §6 epilogue (kernel post-phase-10): a leader removed by its
+        # committed simple config resigns.
+        if (active[g] and role[g] == LEADER and vnew2 == 0
+                and cidx2 <= commit[g] and not (voters2 >> me) & 1):
+            role[g] = FOLLOWER
+            leader_id[g] = NIL
+            elect_dl[g] = now + rand_to[g]
+
+        info["conf_word"][g] = w2
+        info["conf_idx"][g] = cidx2
+        info["conf_pending"][g] = cidx2 > commit[g]
+        conf_idx_st[g], conf_word_st[g] = cidx2, w2
 
         # ---- 11. flight recorder ------------------------------------------
         # (kernel trailing block: same masks, same canonical order, same
@@ -693,15 +882,22 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
             tr_emit(old_role[g] == LEADER and role[g] != LEADER,
                     TR_STEPPED_DOWN, leader_id[g])
             tr_emit(start_pre, TR_BECAME_PRE_CANDIDATE, 0)
+            # Candidacy cause: 0 prevote / 1 timer / 2 TimeoutNow.
             tr_emit(became_cand, TR_BECAME_CANDIDATE,
-                    1 if timer_cand else 0)
+                    (2 if tn_cand else 1) if timer_cand else 0)
             tr_emit(vote_win, TR_BECAME_LEADER, info["noop_idx"][g])
             tr_emit(snap_inst, TR_SNAPSHOT_INSTALL, h["snap_idx"][g])
             tr_emit(commit[g] > old_commit[g], TR_COMMIT_ADVANCE, commit[g])
             tr_emit(n_rel > 0, TR_READ_RELEASE, n_served)
+            tr_emit(w2 != w0 or cidx2 != cidx0, TR_CONF_CHANGE_ENTER, w2)
+            tr_emit(cidx2 > 0 and old_commit[g] < cidx2 <= commit[g],
+                    TR_CONF_CHANGE_COMMIT, cidx2)
+            tr_emit(fire, TR_LEADER_TRANSFER, xfer_to[g])
 
         ring[g] = log.ring
+        cring[g] = log.cring
         base[g], base_term[g], last[g] = log.base, log.base_term, log.last
+        base_conf[g] = log.base_conf
         info["dirty"][g] = (term[g] != old_term[g] or voted[g] != old_voted[g]
                             or last[g] != old_last[g] or app_to > 0)
         info["appended_from"][g] = app_from
@@ -721,7 +917,8 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         "leader_id": leader_id,
         "commit": commit,
         "applied": s["applied"],
-        "log.term": ring, "log.base": base, "log.base_term": base_term,
+        "log.term": ring, "log.conf": cring, "log.base": base,
+        "log.base_term": base_term, "log.base_conf": base_conf,
         "log.last": last,
         "own_from": own_from_a.astype(np.int32),
         "next_idx": next_idx, "match_idx": match_idx,
@@ -734,6 +931,8 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         "read_evid": read_evid,
         "rq_idx": rq_idx, "rq_stamp": rq_stamp, "rq_n": rq_n,
         "rq_head": rq_head, "rq_len": rq_len,
+        "conf_idx": conf_idx_st, "conf_word": conf_word_st,
+        "xfer_to": xfer_to, "xfer_dl": xfer_dl,
     }
     if has_trace:
         new_state.update({

@@ -46,6 +46,12 @@ HELLO, MSGS, SNAP_REQ, SNAP_HDR, FWD_REQ, FWD_RESP = 1, 2, 3, 4, 5, 6
 # to the serve side's read handler (RaftNode.read) instead of submit —
 # reads must execute on the leader but never enter the log.
 FWD_READ = 7
+# Membership-op forward: a follower relays a §6 change or a leadership
+# transfer to the current leader.  Body: group u32 | op u8 (CONF_OP_*) |
+# timeout_ms u32 | a u32 | b u32 (conf: voters/learners masks; xfer:
+# target/0).  Replies travel as FWD_RESP with a JSON result.
+FWD_CONF = 8
+CONF_OP_CHANGE, CONF_OP_TRANSFER = 1, 2
 
 MAX_BODY = 64 << 20  # 64 MB cap, matching the reference (EventCodec.java:26)
 
@@ -119,16 +125,19 @@ class PayloadRun:
 # order; dtypes/shapes come from the Messages template at pack/unpack time.
 KIND_FIELDS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "ae": ("ae_valid", ("ae_term", "ae_prev_idx", "ae_prev_term",
-                        "ae_commit", "ae_n", "ae_ents", "ae_occ",
-                        "ae_tick")),
+                        "ae_commit", "ae_n", "ae_ents", "ae_cents",
+                        "ae_occ", "ae_tick")),
     "aer": ("aer_valid", ("aer_term", "aer_success", "aer_match",
                           "aer_empty", "aer_occ", "aer_tick")),
     "rv": ("rv_valid", ("rv_term", "rv_last_idx", "rv_last_term",
                         "rv_prevote")),
     "rvr": ("rvr_valid", ("rvr_term", "rvr_granted", "rvr_prevote",
                           "rvr_echo")),
-    "is": ("is_valid", ("is_term", "is_idx", "is_last_term", "is_probe")),
+    "is": ("is_valid", ("is_term", "is_idx", "is_last_term", "is_probe",
+                        "is_conf")),
     "isr": ("isr_valid", ("isr_term", "isr_success", "isr_probe")),
+    # TimeoutNow (§3.10 leadership transfer).
+    "tn": ("tn_valid", ("tn_term",)),
 }
 KIND_IDS = {k: i for i, k in enumerate(KIND_FIELDS)}
 KIND_BY_ID = {i: k for k, i in KIND_IDS.items()}
@@ -237,6 +246,45 @@ def pack_fwd_req(group: int, payload: bytes,
 def unpack_fwd_req(body: bytes) -> Tuple[int, float, bytes]:
     group, tmo_ms = struct.unpack_from("<II", body, 0)
     return group, tmo_ms / 1000.0, body[8:]
+
+
+def pack_fwd_conf(group: int, op: int, a: int, b: int,
+                  timeout_s: float = 30.0) -> bytes:
+    """Membership-op forward frame (see FWD_CONF): ``op`` CONF_OP_CHANGE
+    carries (voters, learners) masks in (a, b); CONF_OP_TRANSFER carries
+    (target, 0)."""
+    tmo_ms = max(1, min(int(timeout_s * 1000), 0xFFFFFFFF))
+    return frame(FWD_CONF, struct.pack("<IBIII", group, op, tmo_ms, a, b))
+
+
+def unpack_fwd_conf(body: bytes) -> Tuple[int, int, float, int, int]:
+    group, op, tmo_ms, a, b = struct.unpack("<IBIII", body)
+    return group, op, tmo_ms / 1000.0, a, b
+
+
+def serve_conf(node, group: int, op: int, a: int, b: int,
+               timeout_s: float) -> Tuple[bool, bytes]:
+    """Shared serve-side contract for FWD_CONF (TCP and loopback): run
+    the membership op on the local node and report the JSON-encoded
+    result, with the same REFUSED/FAILED wire taxonomy as
+    :func:`serve_forward` (a marked refusal provably never entered the
+    log and is retry-safe)."""
+    import json as _json
+
+    from ..api.anomaly import is_refusal
+    if node is None:
+        return False, b"FAILED:forwarding disabled"
+    try:
+        if op == CONF_OP_CHANGE:
+            fut = node.change_membership(group, a, b)
+        elif op == CONF_OP_TRANSFER:
+            fut = node.transfer_leadership(group, a)
+        else:
+            return False, f"FAILED:unknown membership op {op}".encode()
+        return True, _json.dumps(fut.result(timeout=timeout_s)).encode()
+    except Exception as e:
+        tag = "REFUSED" if is_refusal(e) else "FAILED"
+        return False, f"{tag}:{type(e).__name__}: {e}".encode()
 
 
 def pack_fwd_resp(ok: bool, result: bytes) -> bytes:

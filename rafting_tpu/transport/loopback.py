@@ -76,7 +76,8 @@ class LoopbackTransport:
                  snapshot_provider: Optional[Callable] = None,
                  submit_handler: Optional[Callable] = None,
                  result_encoder: Optional[Callable] = None,
-                 read_handler: Optional[Callable] = None):
+                 read_handler: Optional[Callable] = None,
+                 conf_node=None):
         self.net = network
         self.node_id = node_id
         self.cfg = cfg
@@ -86,6 +87,7 @@ class LoopbackTransport:
         self.submit_handler = submit_handler
         self.result_encoder = result_encoder
         self.read_handler = read_handler
+        self.conf_node = conf_node
 
     def start(self) -> None:
         self.net.transports[self.node_id] = self
@@ -137,6 +139,18 @@ class LoopbackTransport:
             return False, b"peer down"
         return codec.serve_forward(t.read_handler, group, payload, timeout,
                                    t.result_encoder)
+
+    def forward_conf(self, peer: int, group: int, op: int, a: int, b: int,
+                     timeout: float = 30.0):
+        """Relay a membership op (§6 change / leadership transfer) to the
+        leader — the loopback analog of TcpTransport.forward_conf."""
+        if not (self.net._up(self.node_id, peer)
+                and self.net._up(peer, self.node_id)):
+            return False, b"link down"
+        t = self.net.transports.get(peer)
+        if t is None:
+            return False, b"peer down"
+        return codec.serve_conf(t.conf_node, group, op, a, b, timeout)
 
     def fetch_snapshot(self, peer: int, group: int, index: int, term: int,
                        dest_path: str, timeout: float = 60.0

@@ -118,13 +118,17 @@ class TcpTransport:
                  snapshot_provider: Optional[Callable] = None,
                  submit_handler: Optional[Callable] = None,
                  result_encoder: Optional[Callable] = None,
-                 read_handler: Optional[Callable] = None):
+                 read_handler: Optional[Callable] = None,
+                 conf_node=None):
         """``submit_handler(group, payload) -> Future`` serves forwarded
         client commands (None -> forwards are refused).
         ``read_handler(group, payload) -> Future`` serves forwarded
         linearizable reads (RaftNode.read; None -> read forwards refused).
         ``result_encoder(result) -> bytes`` encodes forwarded apply results
-        (the node's CmdSerializer, api/serial.py; default JSON)."""
+        (the node's CmdSerializer, api/serial.py; default JSON).
+        ``conf_node`` serves forwarded membership ops (FWD_CONF): any
+        object with change_membership/transfer_leadership — normally the
+        RaftNode itself (None -> membership forwards refused)."""
         self.node_id = node_id
         self.peers = peers
         self.cfg = cfg
@@ -134,6 +138,7 @@ class TcpTransport:
         self.submit_handler = submit_handler
         self.result_encoder = result_encoder
         self.read_handler = read_handler
+        self.conf_node = conf_node
         self._hello = codec.pack_hello(node_id, cfg.n_groups, cfg.n_peers,
                                        cfg.batch)
         self._senders: Dict[int, PeerSender] = {}
@@ -303,6 +308,12 @@ class TcpTransport:
                     elif ftype == codec.FWD_READ:
                         self._serve_forward(conn, body, read=True)
                         return  # ephemeral: one read, then close
+                    elif ftype == codec.FWD_CONF:
+                        group, op, tmo, a, b = codec.unpack_fwd_conf(body)
+                        ok, res = codec.serve_conf(self.conf_node, group,
+                                                   op, a, b, tmo)
+                        conn.sendall(codec.pack_fwd_resp(ok, res))
+                        return  # ephemeral: one membership op, then close
         except (OSError, IOError, ValueError, struct.error):
             # Malformed frames (struct/ValueError from a buggy or hostile
             # peer) end the connection cleanly, same as transport errors.
@@ -325,6 +336,26 @@ class TcpTransport:
         """Relay a linearizable read to ``peer`` (the leader) and wait for
         the query result — the read-plane sibling of forward_submit."""
         return self._forward(peer, group, payload, timeout, codec.FWD_READ)
+
+    def forward_conf(self, peer: int, group: int, op: int, a: int, b: int,
+                     timeout: float = 30.0) -> Tuple[bool, bytes]:
+        """Relay a membership op (§6 change / leadership transfer) to
+        ``peer`` over an ephemeral FWD_CONF connection."""
+        try:
+            with socket.create_connection(self.peers[peer],
+                                          timeout=timeout) as sock:
+                sock.settimeout(timeout + 1.0)
+                sock.sendall(codec.pack_fwd_conf(group, op, a, b, timeout))
+                reader = codec.FrameReader()
+                while True:
+                    data = sock.recv(1 << 20)
+                    if not data:
+                        return False, b"connection closed"
+                    for ftype_r, body in reader.feed(data):
+                        if ftype_r == codec.FWD_RESP:
+                            return codec.unpack_fwd_resp(body)
+        except OSError as e:
+            return False, str(e).encode()
 
     def _forward(self, peer: int, group: int, payload: bytes,
                  timeout: float, ftype: int) -> Tuple[bool, bytes]:

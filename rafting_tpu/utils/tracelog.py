@@ -39,6 +39,26 @@ TR_SNAPSHOT_INSTALL = 6
 TR_COMMIT_ADVANCE = 7
 TR_READ_RELEASE = 8
 TR_CRASH_RESTART = 9
+# Membership plane (Raft §6 joint consensus + §3.10 leadership transfer).
+# CONF_CHANGE_ENTER fires whenever the group's ACTIVE config changes —
+# enter-joint, auto-leave, learner-set change, follower adoption, and
+# truncation rollback all count; aux = the new packed config word.
+# CONF_CHANGE_COMMIT fires when the commit index first covers the active
+# config entry; aux = that entry's log index.  LEADER_TRANSFER fires on
+# the leader the tick it sends TimeoutNow; aux = the target peer.
+TR_CONF_CHANGE_ENTER = 10
+TR_CONF_CHANGE_COMMIT = 11
+TR_LEADER_TRANSFER = 12
+
+# Packed-config-word layout (§6 membership plane).  OWNED here like the
+# event taxonomy — the decoder must unpack config words with no engine
+# import, and core/types.py imports these back for the kernel, so both
+# sides share one definition.
+CONF_MASK_BITS = 10
+CONF_MASK = (1 << CONF_MASK_BITS) - 1
+CONF_NEW_SHIFT = CONF_MASK_BITS
+CONF_LRN_SHIFT = 2 * CONF_MASK_BITS
+CONF_FLAG = 1 << 30
 
 TRACE_EVENTS = {
     TR_TERM_BUMP: "TERM_BUMP",
@@ -50,13 +70,18 @@ TRACE_EVENTS = {
     TR_COMMIT_ADVANCE: "COMMIT_ADVANCE",
     TR_READ_RELEASE: "READ_RELEASE",
     TR_CRASH_RESTART: "CRASH_RESTART",
+    TR_CONF_CHANGE_ENTER: "CONF_CHANGE_ENTER",
+    TR_CONF_CHANGE_COMMIT: "CONF_CHANGE_COMMIT",
+    TR_LEADER_TRANSFER: "LEADER_TRANSFER",
 }
 
 __all__ = ["TraceEvent", "TraceLog", "decode_group", "trace_to_numpy",
            "save_dump", "load_dump", "TRACE_EVENTS",
            "TR_TERM_BUMP", "TR_STEPPED_DOWN", "TR_BECAME_PRE_CANDIDATE",
            "TR_BECAME_CANDIDATE", "TR_BECAME_LEADER", "TR_SNAPSHOT_INSTALL",
-           "TR_COMMIT_ADVANCE", "TR_READ_RELEASE", "TR_CRASH_RESTART"]
+           "TR_COMMIT_ADVANCE", "TR_READ_RELEASE", "TR_CRASH_RESTART",
+           "TR_CONF_CHANGE_ENTER", "TR_CONF_CHANGE_COMMIT",
+           "TR_LEADER_TRANSFER"]
 
 
 class TraceEvent(dict):
@@ -118,7 +143,12 @@ class TraceLog:
     * ``elections_cause_prevote``  — candidacies from a PreVote majority
     * ``leader_churn``             — leadership changes past each group's
                                      first election (the stability signal)
+    * ``elections_cause_transfer`` — candidacies from TimeoutNow (§3.10
+                                     leadership transfer)
     * ``crash_restarts``           — in-scan crash-restart events
+    * ``conf_changes_entered``     — active-config changes (ENTER events)
+    * ``conf_changes_committed``   — config entries whose commit landed
+    * ``leader_transfers``         — TimeoutNow sends (LEADER_TRANSFER)
     * ``trace_events``             — everything decoded this drain
     * ``trace_dropped``            — events the ring overwrote undrained
     """
@@ -150,9 +180,11 @@ class TraceLog:
     def _ingest(self, trace) -> Dict[str, int]:
         lanes = trace_to_numpy(trace)
         deltas = {"elections_won": 0, "elections_cause_timer": 0,
-                  "elections_cause_prevote": 0, "leader_churn": 0,
-                  "crash_restarts": 0, "trace_events": 0,
-                  "trace_dropped": 0}
+                  "elections_cause_prevote": 0,
+                  "elections_cause_transfer": 0, "leader_churn": 0,
+                  "crash_restarts": 0, "conf_changes_entered": 0,
+                  "conf_changes_committed": 0, "leader_transfers": 0,
+                  "trace_events": 0, "trace_dropped": 0}
         moved = np.nonzero(lanes["n"].astype(np.int64) > self._seen)[0]
         for g in moved.tolist():
             events, dropped = decode_group(lanes, g,
@@ -172,11 +204,20 @@ class TraceLog:
                         deltas["leader_churn"] += 1
                     self._led_before[g] = True
                 elif k == TR_BECAME_CANDIDATE:
-                    cause = ("elections_cause_timer" if ev["aux"]
-                             else "elections_cause_prevote")
+                    # aux: 0 = PreVote majority, 1 = timer expiry,
+                    # 2 = TimeoutNow (leadership transfer).
+                    cause = ("elections_cause_prevote",
+                             "elections_cause_timer",
+                             "elections_cause_transfer")[min(ev["aux"], 2)]
                     deltas[cause] += 1
                 elif k == TR_CRASH_RESTART:
                     deltas["crash_restarts"] += 1
+                elif k == TR_CONF_CHANGE_ENTER:
+                    deltas["conf_changes_entered"] += 1
+                elif k == TR_CONF_CHANGE_COMMIT:
+                    deltas["conf_changes_committed"] += 1
+                elif k == TR_LEADER_TRANSFER:
+                    deltas["leader_transfers"] += 1
         self.dropped_total += deltas["trace_dropped"]
         return deltas
 
@@ -191,6 +232,25 @@ class TraceLog:
             self._seen[g] = 0
             self._timelines.pop(g, None)
             self._led_before[g] = False
+
+
+def format_aux(kind: int, aux: int) -> str:
+    """Human rendering of an event's aux payload (decoder-owned, like the
+    taxonomy itself): config words decode into voter/new/learner masks,
+    candidacy causes into names — everything else prints raw."""
+    if kind == TR_CONF_CHANGE_ENTER:
+        v = aux & CONF_MASK
+        n = (aux >> CONF_NEW_SHIFT) & CONF_MASK
+        l = (aux >> CONF_LRN_SHIFT) & CONF_MASK
+        s = f"voters={v:b}"
+        if n:
+            s += f" new={n:b}"
+        if l:
+            s += f" learners={l:b}"
+        return s
+    if kind == TR_BECAME_CANDIDATE:
+        return ("prevote", "timer", "timeout_now")[min(int(aux), 2)]
+    return str(aux)
 
 
 # ------------------------------------------------------------------ dumps --

@@ -112,3 +112,42 @@ def test_windowed_rates_cover_absolute_set_counters():
     # Lifetime rate would have counted all 530.
     assert m.rates()["frontier_per_sec"] > r * 0  # both defined
     assert not math.isnan(r)
+
+
+def test_membership_counters_on_metrics(tmp_path):
+    """ISSUE 7 satellite: the membership-change and leadership-transfer
+    counters render on /metrics from boot (zeros included), move with a
+    real §6 change, and the page still passes the strict validator."""
+    from rafting_tpu.core.types import EngineConfig
+    from rafting_tpu.testkit.harness import LocalCluster
+
+    cfg = EngineConfig(n_groups=1, n_peers=3, log_slots=16, batch=4,
+                       max_submit=4, election_ticks=6, heartbeat_ticks=2,
+                       rpc_timeout_ticks=5)
+    c = LocalCluster(cfg, str(tmp_path))
+    try:
+        c.wait_leader(0)
+        c.submit_via_leader(0, b"x")   # waits out the readiness gate too
+        node = c.nodes[c.leader_of(0)]
+        text = node.metrics.render_prometheus()
+        validate_exposition(text)
+        for name in ("raft_membership_changes_entered_total",
+                     "raft_membership_changes_committed_total",
+                     "raft_membership_changes_aborted_total",
+                     "raft_leadership_transfers_attempted_total",
+                     "raft_leadership_transfers_succeeded_total",
+                     "raft_timeout_now_sent_total"):
+            assert name in text, f"{name} missing from exposition"
+        # A real change moves entered/committed (joint + auto-leave).
+        fut = node.change_membership(0, 0b011)
+        for _ in range(400):
+            if fut.done():
+                break
+            c.tick()
+        fut.result()
+        text = node.metrics.render_prometheus()
+        validate_exposition(text)
+        assert node.metrics["membership_changes_entered"] >= 2
+        assert node.metrics["membership_changes_committed"] >= 2
+    finally:
+        c.close()

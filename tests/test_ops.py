@@ -26,8 +26,16 @@ def _random_case(rng, G, P, L):
     # (exercises both grant and refuse sides of the own-term rule).
     own_from = rng.integers(0, L + 4, G).astype(np.int32)
     lead = (rng.random(G) < 0.7)
+    full = (1 << P) - 1
+    # Random voter sets (always nonempty), ~half the lanes JOINT with an
+    # independently random C_new — the membership plane's whole input
+    # space (learner slots are simply absent from both masks).
+    voters = (rng.integers(1, full + 1, G)).astype(np.int32)
+    voters_new = np.where(rng.random(G) < 0.5,
+                          rng.integers(1, full + 1, G), 0).astype(np.int32)
     return (jnp.asarray(match), jnp.asarray(own_from), jnp.asarray(last),
-            jnp.asarray(commit), jnp.asarray(lead))
+            jnp.asarray(commit), jnp.asarray(lead), jnp.asarray(voters),
+            jnp.asarray(voters_new))
 
 
 # L=256 with P=5 is the TUNED bench shape (config-4's peer count with
@@ -35,23 +43,43 @@ def _random_case(rng, G, P, L):
 # exactly this shape 4x more expensive than the benched L=64; the
 # own_from reduction removed the ring from the kernel entirely, and this
 # parametrization keeps the tuned shape pinned in the suite.
-@pytest.mark.parametrize("P,majority,L", [(3, 2, 16), (5, 3, 256),
-                                          (7, 4, 64)])
-def test_pallas_quorum_matches_reference(P, majority, L):
+@pytest.mark.parametrize("P,L", [(3, 16), (5, 256), (7, 64)])
+def test_pallas_quorum_matches_reference(P, L):
     rng = np.random.default_rng(42 + P)
     G = 1000   # odd G exercises lane padding
-    match, own_from, last, commit, lead = _random_case(rng, G, P, L)
-    ref = quorum_commit_ref(match, own_from, last, commit, lead, majority)
-    state_vec = jnp.stack([commit, last, lead.astype(jnp.int32)])
+    match, own_from, last, commit, lead, voters, vnew = \
+        _random_case(rng, G, P, L)
+    ref = quorum_commit_ref(match, own_from, last, commit, lead, voters,
+                            vnew)
+    state_vec = jnp.stack([commit, last, lead.astype(jnp.int32),
+                           voters, vnew])
     interpret = jax.default_backend() != "tpu"
-    got = quorum_commit_pallas(match, own_from, state_vec, majority,
-                               interpret)
+    got = quorum_commit_pallas(match, own_from, state_vec, interpret)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_masked_quorum_full_membership_matches_fixed():
+    """With every slot a voter (the boot config), the masked kernel must
+    reproduce the legacy fixed-majority order statistic exactly — the
+    BENCH_MEMBER A/B's correctness premise."""
+    import dataclasses as _dc
+
+    from rafting_tpu.ops.quorum import quorum_commit_fixed
+
+    rng = np.random.default_rng(7)
+    P, L, G = 3, 16, 500
+    match, own_from, last, commit, lead, _, _ = _random_case(rng, G, P, L)
+    full = jnp.full((G,), (1 << P) - 1, jnp.int32)
+    zero = jnp.zeros((G,), jnp.int32)
+    ref = quorum_commit_ref(match, own_from, last, commit, lead, full, zero)
+    cfg = EngineConfig(n_groups=G, n_peers=P)
+    got = quorum_commit_fixed(cfg, match, last, commit, own_from, lead)
     np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
 
 
 def test_full_replication_commit_lane():
-    """Reference Leader.java:260: an index replicated on ALL nodes (min of
-    the match row) commits even below own_from — the lane that lets a
+    """Reference Leader.java:260: an index replicated on ALL voters (min
+    over VOTER slots) commits even below own_from — the lane that lets a
     fully-replicated prior-term suffix commit on a ring-full lane where
     the §8 no-op could not be appended.  A majority-only match must still
     respect the own-term fence."""
@@ -59,16 +87,65 @@ def test_full_replication_commit_lane():
     last = jnp.asarray([4, 4], jnp.int32)
     commit = jnp.asarray([0, 0], jnp.int32)
     lead = jnp.asarray([True, True])
+    voters = jnp.asarray([0b111, 0b111], jnp.int32)
+    vnew = jnp.zeros(2, jnp.int32)
     # Group 0: full replication at 4 -> commits to 4 despite own_from=5.
     # Group 1: majority at 4 but one peer at 0 -> fence holds, commit 0.
     match = jnp.asarray([[4, 4, 4], [4, 4, 0]], jnp.int32)
-    got = quorum_commit_ref(match, own_from, last, commit, lead, 2)
+    got = quorum_commit_ref(match, own_from, last, commit, lead, voters,
+                            vnew)
     np.testing.assert_array_equal(np.asarray(got), [4, 0])
     # The Pallas kernel implements the same two lanes.
-    state_vec = jnp.stack([commit, last, lead.astype(jnp.int32)])
+    state_vec = jnp.stack([commit, last, lead.astype(jnp.int32), voters,
+                           vnew])
     interpret = jax.default_backend() != "tpu"
-    got_k = quorum_commit_pallas(match, own_from, state_vec, 2, interpret)
+    got_k = quorum_commit_pallas(match, own_from, state_vec, interpret)
     np.testing.assert_array_equal(np.asarray(got_k), [4, 0])
+
+
+def test_full_replication_lane_ignores_learners():
+    """ISSUE 7 small fix: the full-replication lane takes the min over
+    VOTER slots only — a learner hauling itself up from a snapshot
+    (match 0) must not stall fullIndex.  P=4: slots 0-2 voters at match
+    4, slot 3 a lagging learner at 0."""
+    own_from = jnp.asarray([5], jnp.int32)      # own-term fence would block
+    last = jnp.asarray([4], jnp.int32)
+    commit = jnp.asarray([0], jnp.int32)
+    lead = jnp.asarray([True])
+    voters = jnp.asarray([0b0111], jnp.int32)   # learner slot 3 excluded
+    vnew = jnp.zeros(1, jnp.int32)
+    match = jnp.asarray([[4, 4, 4, 0]], jnp.int32)
+    got = quorum_commit_ref(match, own_from, last, commit, lead, voters,
+                            vnew)
+    np.testing.assert_array_equal(np.asarray(got), [4])
+    state_vec = jnp.stack([commit, last, lead.astype(jnp.int32), voters,
+                           vnew])
+    interpret = jax.default_backend() != "tpu"
+    got_k = quorum_commit_pallas(match, own_from, state_vec, interpret)
+    np.testing.assert_array_equal(np.asarray(got_k), [4])
+
+
+def test_joint_quorum_needs_both_sets():
+    """§6: while joint, an index commits only with a quorum in BOTH
+    C_old and C_new."""
+    own_from = jnp.asarray([1, 1], jnp.int32)
+    last = jnp.asarray([4, 4], jnp.int32)
+    commit = jnp.asarray([0, 0], jnp.int32)
+    lead = jnp.asarray([True, True])
+    # P=5: C_old = {0,1,2}, C_new = {3,4}.
+    voters = jnp.asarray([0b00111, 0b00111], jnp.int32)
+    vnew = jnp.asarray([0b11000, 0b11000], jnp.int32)
+    # Group 0: quorum in C_old (0,1) but NOT in C_new (3 at 0, 4 at 0).
+    # Group 1: quorums in both sets -> commit 4.
+    match = jnp.asarray([[4, 4, 0, 0, 0], [4, 4, 0, 4, 4]], jnp.int32)
+    got = quorum_commit_ref(match, own_from, last, commit, lead, voters,
+                            vnew)
+    np.testing.assert_array_equal(np.asarray(got), [0, 4])
+    state_vec = jnp.stack([commit, last, lead.astype(jnp.int32), voters,
+                           vnew])
+    interpret = jax.default_backend() != "tpu"
+    got_k = quorum_commit_pallas(match, own_from, state_vec, interpret)
+    np.testing.assert_array_equal(np.asarray(got_k), [0, 4])
 
 
 def test_engine_parity_with_pallas_quorum():

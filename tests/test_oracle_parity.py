@@ -30,12 +30,13 @@ from rafting_tpu.testkit.oracle import _np, oracle_step
 # values elsewhere.
 MSG_GROUPS = {
     "ae_valid": ["ae_term", "ae_prev_idx", "ae_prev_term", "ae_commit",
-                 "ae_n", "ae_ents", "ae_tick"],
+                 "ae_n", "ae_ents", "ae_cents", "ae_tick"],
     "aer_valid": ["aer_term", "aer_success", "aer_match", "aer_tick"],
     "rv_valid": ["rv_term", "rv_last_idx", "rv_last_term", "rv_prevote"],
     "rvr_valid": ["rvr_term", "rvr_granted", "rvr_prevote", "rvr_echo"],
-    "is_valid": ["is_term", "is_idx", "is_last_term"],
+    "is_valid": ["is_term", "is_idx", "is_last_term", "is_conf"],
     "isr_valid": ["isr_term", "isr_success"],
+    "tn_valid": ["tn_term"],
 }
 
 
@@ -89,10 +90,18 @@ def route_numpy(outboxes, conn):
 
 def run_parity(seed: int, n_ticks: int, cfg: EngineConfig,
                drop_p: float = 0.15, part_p: float = 0.1,
-               crash_p: float = 0.0, stall_p: float = 0.0):
+               crash_p: float = 0.0, stall_p: float = 0.0,
+               conf_p: float = 0.0, xfer_p: float = 0.0,
+               n_voters=None):
+    """``conf_p``/``xfer_p``: per-group per-tick probability of offering a
+    random membership-change / leadership-transfer request through the
+    host inbox (the §6 plane's chaos input — only leaders take them, and
+    the one-in-flight gate drops the rest, all of which is part of the
+    checked semantics).  ``n_voters`` bounds the boot voter set."""
     N, G = cfg.n_peers, cfg.n_groups
     rng = np.random.default_rng(seed)
-    states = [init_state(cfg, i, seed=seed) for i in range(N)]
+    states = [init_state(cfg, i, seed=seed, n_voters=n_voters)
+              for i in range(N)]
     outboxes = [Messages.empty(cfg) for _ in range(N)]
     infos = [None] * N
     partition_left = 0
@@ -154,7 +163,23 @@ def run_parity(seed: int, n_ticks: int, cfg: EngineConfig,
             # occasional host read-veto (process-pause detection).
             reads = rng.integers(0, 4, size=G).astype(np.int32)
             veto = bool(rng.random() < 0.05)
+            # Membership chaos (conf_p/xfer_p): random target configs and
+            # transfer targets through the host lanes.
+            full = (1 << N) - 1
+            cv = np.where(rng.random(G) < conf_p,
+                          rng.integers(1, full + 1, size=G),
+                          0).astype(np.int32)
+            cl = (np.where(rng.random(G) < 0.5,
+                           rng.integers(0, full + 1, size=G), 0)
+                  .astype(np.int32) & ~cv).astype(np.int32) \
+                if conf_p else np.zeros(G, np.int32)
+            xt = np.where(rng.random(G) < xfer_p,
+                          rng.integers(0, N, size=G),
+                          -1).astype(np.int32)
             host = HostInbox.empty(cfg)
+            if conf_p or xfer_p:
+                host = host.replace(conf_voters=cv, conf_learners=cl,
+                                    xfer_target=xt)
             if infos[n] is not None:
                 prev = infos[n]
                 compact = np.where(
@@ -169,6 +194,7 @@ def run_parity(seed: int, n_ticks: int, cfg: EngineConfig,
                     snap_done=np.asarray(prev.snap_req),
                     snap_idx=np.asarray(prev.snap_req_idx),
                     snap_term=np.asarray(prev.snap_req_term),
+                    snap_conf=np.asarray(prev.snap_req_conf),
                     compact_to=compact)
             else:
                 host = host.replace(submit_n=sub, read_n=reads,

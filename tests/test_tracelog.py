@@ -50,7 +50,7 @@ def test_trace_depth_zero_compiles_away():
     # field but zero leaves, so flatten sees the seed layout.
     leaves_now = len(jax.tree.leaves(states))
     leaves_traced = len(jax.tree.leaves(
-        init_state(EngineConfig(trace_depth=8, **CFG_KW), 0)))
+        init_state(EngineConfig(trace_depth=16, **CFG_KW), 0)))
     assert leaves_traced == leaves_now + 5  # the 5 TraceState lanes
 
 
@@ -103,9 +103,9 @@ def test_trace_append_ring_semantics():
 
 
 def test_tracelog_ingest_and_labeled_metrics():
-    cfg = EngineConfig(trace_depth=8, **CFG_KW)
+    cfg = EngineConfig(trace_depth=16, **CFG_KW)
     tl = TraceLog(cfg)
-    tr = TraceState.empty(cfg.n_groups, 8)
+    tr = TraceState.empty(cfg.n_groups, 16)
     m_all = jax.numpy.ones(cfg.n_groups, bool)
     # Two elections in group order: first win, then churn.
     from rafting_tpu.core.types import TR_BECAME_CANDIDATE
@@ -210,4 +210,4 @@ def test_nemesis_schedule_crash_events_accounted():
 
 
 def test_trace_events_have_names():
-    assert set(TRACE_EVENTS) == set(range(1, 10))
+    assert set(TRACE_EVENTS) == set(range(1, 13))

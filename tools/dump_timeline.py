@@ -75,7 +75,7 @@ def main(argv=None) -> int:
             for ev in doc["events"]:
                 print(f"  #{ev['seq']:<5d} tick {ev['tick']:<8d} "
                       f"term {ev['term']:<6d} {ev['event']:<22s} "
-                      f"aux={ev['aux']}")
+                      f"aux={tracelog.format_aux(ev['kind'], ev['aux'])}")
         if not out:
             print("no events recorded")
     except BrokenPipeError:   # `... | head` is the normal workflow
