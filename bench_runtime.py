@@ -52,10 +52,13 @@ def _shape(n_groups: int):
 
 
 def run(n_groups: int = 1024, rounds: int = 0, burst_n: int = 0,
-        transport: str = "loopback", pipeline=None) -> dict:
+        transport: str = "loopback", pipeline=None,
+        host_workers=None) -> dict:
     """``pipeline``: True/False forces the durable pipeline on/off for
     every node; None uses the runtime default (RAFT_PIPELINE env if set,
-    else on only for accelerator engine backends — see RaftNode)."""
+    else on only for accelerator engine backends — see RaftNode).
+    ``host_workers``: striped host tier width per node (None = the
+    runtime default, env RAFT_HOST_WORKERS else 1 = serial)."""
     from rafting_tpu.core.types import EngineConfig, LEADER
     from rafting_tpu.testkit.fixtures import NullProvider
     from rafting_tpu.testkit.harness import LocalCluster
@@ -79,7 +82,8 @@ def run(n_groups: int = 1024, rounds: int = 0, burst_n: int = 0,
         election_ticks=10, heartbeat_ticks=3, rpc_timeout_ticks=8)
     root = tempfile.mkdtemp(prefix="bench-runtime-")
     c = LocalCluster(cfg, root, provider_factory=NullProvider, seed=0,
-                     transport=transport, pipeline=pipeline)
+                     transport=transport, pipeline=pipeline,
+                     host_workers=host_workers)
     payload = b"x" * 64
     burst = [payload] * burst_n
 
@@ -170,6 +174,7 @@ def run(n_groups: int = 1024, rounds: int = 0, burst_n: int = 0,
             "burst_per_group": burst_n,
             "rounds": rounds,
             "pipeline": bool(slow.pipeline),
+            "host_workers": int(slow._w_eff),
             "wal_shards": getattr(getattr(slow.store, "wal", None),
                                   "n_shards", 1),
             "tick_latency": lat,
@@ -224,3 +229,28 @@ if __name__ == "__main__":
                 "pipelined_stages_mean_s": piped["tick_stages_mean_s"],
                 "serial_stages_mean_s": serial["tick_stages_mean_s"],
             }), flush=True)
+        if os.environ.get("BENCH_HOSTPAR", "") == "1":
+            # Serial-vs-striped host tier A/B at the same scale: re-run
+            # with host_workers forced to 1 (serial orchestration, the
+            # pre-stripe behaviour), then W=2 and W=4 striped.  Each run
+            # prints its own JSON line (per-stage tick breakdown included
+            # — the striped runs report the max-across-workers stage
+            # times, so stage sums can exceed wall tick time); the
+            # comparison line is striped-vs-serial commits/sec.
+            base = run(n_groups=n, transport=transport, host_workers=1)
+            print(json.dumps(base), flush=True)
+            for w in (2, 4):
+                striped = run(n_groups=n, transport=transport,
+                              host_workers=w)
+                print(json.dumps(striped), flush=True)
+                print(json.dumps({
+                    "metric": f"striped host tier speedup @{n} groups "
+                              f"(W={striped['host_workers']}, {transport})",
+                    "value": round(striped["value"] /
+                                   max(base["value"], 1), 3),
+                    "unit": "x (striped / serial commits/sec)",
+                    "striped_commits_per_sec": striped["value"],
+                    "serial_commits_per_sec": base["value"],
+                    "striped_stages_mean_s": striped["tick_stages_mean_s"],
+                    "serial_stages_mean_s": base["tick_stages_mean_s"],
+                }), flush=True)

@@ -336,6 +336,30 @@ class LogStore:
         self.conf.flush()
         self.wal.sync()
 
+    def sync_stripes(self, stripes) -> None:
+        """Fsync only the given WAL stripes (striped host tier: each
+        worker barriers exactly the shards it staged).  The membership
+        sidecar is NOT flushed here — it is a single global file, so the
+        orchestrator flushes it once per tick before any ack leaves
+        (conf-bearing ticks take the serial host path entirely)."""
+        ss = getattr(self.wal, "sync_shards", None)
+        if ss is not None:
+            ss(stripes)
+        else:
+            self.wal.sync()
+
+    @property
+    def n_stripes(self) -> int:
+        """How many independently fsync-able WAL stripes back this store
+        (1 for an unsharded WAL) — the striped host tier's worker-count
+        ceiling."""
+        return int(getattr(self.wal, "n_shards", 1))
+
+    def conf_flush(self) -> None:
+        """Flush the membership sidecar alone (striped host tier: the
+        orchestrator's share of the durability barrier)."""
+        self.conf.flush()
+
     def checkpoint(self) -> None:
         """Rewrite live state, dropping dead segments (synchronous GC —
         test/offline use; the runtime uses the three-phase path below)."""

@@ -865,6 +865,15 @@ class ShardedWal:
         if err is not None:
             raise err
 
+    def sync_shards(self, shard_ids) -> None:
+        """Fsync only the given shard engines, inline on the calling
+        thread — the striped host tier's durability barrier: each worker
+        owns a disjoint set of shards end-to-end (staging AND fsync), so
+        no cross-thread coordination or pool handoff is needed.  Raises
+        on the first failure (the caller must not acknowledge the tick)."""
+        for k in shard_ids:
+            self.engines[k].sync()
+
     # -- per-group reads -----------------------------------------------
     def tail(self, g):
         return self._e(g).tail(g)

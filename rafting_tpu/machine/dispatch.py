@@ -265,12 +265,27 @@ class ApplyDispatcher:
 
     # -- the apply loop -----------------------------------------------------
 
+    def warm_mirror(self, n: int) -> None:
+        """Materialize the applied-frontier mirror for ``n`` groups on the
+        CALLING thread.  The striped host tier calls this once from the
+        orchestrator before fanning ``advance`` out to stripe workers —
+        lazy creation inside concurrent advance() calls would race the
+        full-array build."""
+        self._applied_mirror(n)
+
     def advance(self, commit: np.ndarray,
                 groups: Optional[np.ndarray] = None,
                 max_per_group: int = 0) -> None:
         """Apply newly committed entries.  `commit` is the [G] frontier;
         `groups` optionally restricts which lanes are live (active mask or
-        index list).  `max_per_group` bounds work per call (0 = no bound)."""
+        index list).  `max_per_group` bounds work per call (0 = no bound).
+
+        Stripe-sliced calls (striped host tier) pass a pre-sliced index
+        view: disjoint group sets make concurrent advance() calls safe —
+        every structure here (machines, promises, skip ledger, the mirror's
+        per-element writes) is keyed or indexed by group.  An index list is
+        intersected with the behind mask exactly like a bool mask, so a
+        stripe view costs no applies for already-caught-up groups."""
         mirror = self._applied_mirror(len(commit))
         behind = commit > mirror[:len(commit)]
         if groups is None:
@@ -278,7 +293,8 @@ class ApplyDispatcher:
         elif groups.dtype == bool:
             gs = np.nonzero(groups & behind)[0]
         else:
-            gs = groups
+            groups = np.asarray(groups, np.int64)
+            gs = groups[behind[groups]]
         retries = self._retry_counts
         for g in gs:
             g = int(g)

@@ -91,6 +91,29 @@ class SnapshotArchive:
             self._dirs.add(g)
         return d
 
+    def groups_with_snapshots(self, n_groups: Optional[int] = None
+                              ) -> List[int]:
+        """Group ids that have an on-disk snapshot directory — ONE listdir
+        of the archive root, no per-group mkdir/stat.  Boot recovery
+        iterates this instead of range(n_groups): a 100k-group cold start
+        with a handful of snapshotted groups costs one directory read,
+        not 100k makedirs (each _gdir call CREATES the directory).  The
+        listing may include groups whose directories exist but hold no
+        snapshot files; callers filter via last_snapshot."""
+        out: List[int] = []
+        for name in os.listdir(self.root):
+            if not name.startswith("g"):
+                continue
+            try:
+                g = int(name[1:])
+            except ValueError:
+                continue
+            if n_groups is not None and g >= n_groups:
+                continue
+            out.append(g)
+        out.sort()
+        return out
+
     # -- local snapshots -----------------------------------------------------
 
     def save_checkpoint(self, g: int, src_path: str, index: int,

@@ -47,7 +47,8 @@ class LocalCluster:
                  serializer_factory: Optional[Callable[[], object]] = None,
                  transport: str = "loopback",
                  pipeline: Optional[bool] = None,
-                 wal_shards: Optional[int] = None):
+                 wal_shards: Optional[int] = None,
+                 host_workers: Optional[int] = None):
         """``provider_factory(node_id)`` returns a MachineProvider; defaults
         to FileMachine per group under ``root/node<i>/machines`` (the
         reference's file-append oracle, cluster/cmd/FileMachine.java).
@@ -63,14 +64,16 @@ class LocalCluster:
         reader-thread / accumulator plane is exercised under the same
         manual-tick control (the reference's system test runs real TCP,
         test/resources/raft1.xml:3-7).
-        ``pipeline`` / ``wal_shards``: forwarded to every RaftNode (see
-        RaftNode.__init__; None = the node's env-driven defaults)."""
+        ``pipeline`` / ``wal_shards`` / ``host_workers``: forwarded to
+        every RaftNode (see RaftNode.__init__; None = the node's
+        env-driven defaults)."""
         self.cfg = cfg
         self.root = root
         self.seed = seed
         self.transport = transport
         self.pipeline = pipeline
         self.wal_shards = wal_shards
+        self.host_workers = host_workers
         self.net = LoopbackNetwork(cfg.n_peers)
         self._ports = free_ports(cfg.n_peers) if transport == "tcp" else None
         self.provider_factory = provider_factory or (
@@ -120,7 +123,8 @@ class LocalCluster:
             serializer=(self.serializer_factory()
                         if self.serializer_factory else None),
             pipeline=self.pipeline,
-            wal_shards=self.wal_shards)
+            wal_shards=self.wal_shards,
+            host_workers=self.host_workers)
         node.transport.start()
         self.nodes[i] = node
         return node

@@ -346,6 +346,104 @@ def unpack_snap_hdr(body: bytes) -> Tuple[int, int, int, bool, int]:
 
 
 
+# Kinds a leader may release BEFORE its tick's fsync in pipelined mode:
+# AppendEntries (incl. heartbeats) only.  Safe because the commit rule
+# counts a leader's own match at min(log.last, durable_tail) (core/step.py
+# HostInbox.durable_tail clamp) — an un-fsynced local range can never be
+# counted toward a majority.  Everything vote- or ack-bearing (rv/rvr, aer,
+# is/isr, tn) reflects state that must be durable before it is announced
+# and stays strictly behind the fsync barrier.
+EAGER_KINDS = ("ae",)
+
+_EMPTY_COLS = np.zeros(0, np.uint32)
+
+
+def pack_kind_section(kind: str, fields: Dict[str, np.ndarray],
+                      payload_window_fn: Optional[Callable[[int, int, int],
+                                                           list]] = None,
+                      payload_runs_fn: Optional[Callable] = None,
+                      cols: Optional[np.ndarray] = None
+                      ) -> Tuple[bytes, int, np.ndarray]:
+    """Pack ONE kind's wire section (the ``<BI>`` kind header + columns +
+    field planes [+ ae payload blob]) for the given column ids.
+
+    ``cols`` defaults to every valid column; a striped packer passes its
+    own group subset so each stripe packs independently and the per-peer
+    sections concatenate via :func:`assemble_slice` (``unpack_slice``
+    accumulates repeated kinds).  Returns ``(section, n_cols, dropped)``:
+    ``dropped`` lists the ``ae`` columns whose payloads were unavailable —
+    an eager (pre-persist) packer defers them to the host phase, where the
+    entries are staged; the serial pack path treats a drop as network loss
+    (the engine's resend/timeout recovers).  Other kinds never drop.
+    """
+    vfield, dfields = KIND_FIELDS[kind]
+    if cols is None:
+        cols = np.nonzero(fields[vfield])[0].astype(np.uint32)
+    else:
+        cols = np.asarray(cols, np.uint32)
+    dropped = _EMPTY_COLS
+    blob_section = b""
+    if kind == "ae" and len(cols):
+        # Resolve payloads for indices prev_idx+1 .. prev_idx+n per
+        # column FIRST.  Blob layout: one u32 length VECTOR for all kept
+        # entries, then the payload bytes concatenated — per-COLUMN bulk
+        # ops (run slices when the store exposes runs, else a bytes
+        # window), never a struct.pack per entry (the pack path is on the
+        # per-tick critical section of every node).
+        prevs = fields["ae_prev_idx"][cols]
+        ns = fields["ae_n"][cols]
+        keep, drop, pieces, len_parts = [], [], [], []
+        for g, prev, n in zip(cols.tolist(), prevs.tolist(), ns.tolist()):
+            if n and payload_runs_fn is not None:
+                run = payload_runs_fn(int(g), prev + 1, n)
+                if run is None:
+                    drop.append(g)
+                    continue
+                keep.append(g)
+                pieces.extend(run[0])
+                len_parts.append(np.asarray(run[1], np.uint32))
+                continue
+            win = (payload_window_fn(int(g), prev + 1, n)
+                   if n and payload_window_fn is not None else
+                   [None] * n if n else [])
+            if any(p is None for p in win):
+                drop.append(g)
+                continue
+            keep.append(g)
+            pieces.extend(win)
+            len_parts.append(np.fromiter(map(len, win), np.uint32,
+                                         len(win)))
+        cols = np.asarray(keep, np.uint32)
+        dropped = np.asarray(drop, np.uint32)
+        lens = (np.concatenate(len_parts) if len_parts
+                else np.zeros(0, np.uint32))
+        blob_section = lens.tobytes() + b"".join(pieces)
+    n_cols = len(cols)
+    parts = [struct.pack("<BI", KIND_IDS[kind], n_cols)]
+    if n_cols:
+        parts.append(cols.tobytes())
+        for f in dfields:
+            parts.append(np.ascontiguousarray(fields[f][cols]).tobytes())
+        parts.append(blob_section)
+    return b"".join(parts), n_cols, dropped
+
+
+def assemble_slice(src: int, sections: List[bytes]) -> bytes:
+    """Concatenate independently packed kind sections into ONE MSGS frame.
+
+    One frame per (src, peer) per tick is a delivery invariant: the inbox
+    accumulator drains one slice per source per tick, so per-stripe or
+    eager/deferred sections must merge here rather than travel as separate
+    frames (which would add a tick of latency each and grow the backlog).
+    Sections may repeat a kind — ``unpack_slice`` concatenates them, and
+    the dense scatter is last-wins in section order for any duplicated
+    (kind, group) lane."""
+    if len(sections) > 255:
+        raise IOError(f"too many MSGS sections ({len(sections)})")
+    return frame(MSGS,
+                 struct.pack("<IB", src, len(sections)) + b"".join(sections))
+
+
 def pack_slice(src: int, fields: Dict[str, np.ndarray],
                payload_fn: Optional[Callable[[int, int], Optional[bytes]]],
                payload_window_fn: Optional[Callable[[int, int, int], list]]
@@ -362,71 +460,29 @@ def pack_slice(src: int, fields: Dict[str, np.ndarray],
     (pieces, lens) | None`` is the zero-copy variant (LogStore.
     payload_runs): contiguous buffer slices + a uint32 length vector, no
     per-entry Python at all — preferred when available.  Returns None when
-    the slice is empty (nothing valid for this peer).
+    the slice is empty (nothing valid for this peer).  An ``ae`` column
+    whose payload is unavailable (e.g. compacted between outbox build and
+    pack) is dropped entirely — indistinguishable from network loss, which
+    the engine's resend/timeout path already recovers; shipping a
+    substitute empty command would silently diverge replica state.
     """
-    if payload_window_fn is None:
+    if payload_window_fn is None and payload_fn is not None:
         # One resolution path: adapt the per-entry fetcher so the packing
-        # logic below (incl. column-drop-on-missing) has a single
-        # implementation exercised by every caller and test.
-        if payload_fn is not None:
-            payload_window_fn = (lambda g, start, n:
-                                 [payload_fn(g, i)
-                                  for i in range(start, start + n)])
-        else:
-            payload_window_fn = lambda g, start, n: [None] * n
-    parts = [struct.pack("<IB", src, len(KIND_FIELDS))]
+        # logic (incl. column-drop-on-missing) has a single implementation
+        # exercised by every caller and test.
+        payload_window_fn = (lambda g, start, n:
+                             [payload_fn(g, i)
+                              for i in range(start, start + n)])
+    sections: List[bytes] = []
     n_total = 0
-    for kind, (vfield, dfields) in KIND_FIELDS.items():
-        valid = fields[vfield]
-        cols = np.nonzero(valid)[0].astype(np.uint32)
-        blob_section = b""
-        if kind == "ae" and len(cols):
-            # Resolve payloads for indices prev_idx+1 .. prev_idx+n per
-            # column FIRST; a column whose payload is unavailable (e.g.
-            # compacted between outbox build and pack) is dropped entirely —
-            # indistinguishable from network loss, which the engine's
-            # resend/timeout path already recovers.  Shipping a substitute
-            # empty command would silently diverge replica state.
-            # Blob layout: one u32 length VECTOR for all kept entries, then
-            # the payload bytes concatenated — per-COLUMN bulk ops (run
-            # slices when the store exposes runs, else a bytes window),
-            # never a struct.pack per entry (the pack path is on the
-            # per-tick critical section of every node).
-            prevs = fields["ae_prev_idx"][cols]
-            ns = fields["ae_n"][cols]
-            keep, pieces, len_parts = [], [], []
-            for g, prev, n in zip(cols.tolist(), prevs.tolist(), ns.tolist()):
-                if n and payload_runs_fn is not None:
-                    run = payload_runs_fn(int(g), prev + 1, n)
-                    if run is None:
-                        continue
-                    keep.append(g)
-                    pieces.extend(run[0])
-                    len_parts.append(np.asarray(run[1], np.uint32))
-                    continue
-                win = payload_window_fn(int(g), prev + 1, n) if n else []
-                if any(p is None for p in win):
-                    continue
-                keep.append(g)
-                pieces.extend(win)
-                len_parts.append(np.fromiter(map(len, win), np.uint32,
-                                             len(win)))
-            cols = np.asarray(keep, np.uint32)
-            lens = (np.concatenate(len_parts) if len_parts
-                    else np.zeros(0, np.uint32))
-            blob_section = lens.tobytes() + b"".join(pieces)
-        n_total += len(cols)
-        parts.append(struct.pack("<BI", KIND_IDS[kind], len(cols)))
-        if len(cols) == 0:
-            continue
-        parts.append(cols.tobytes())
-        for f in dfields:
-            arr = fields[f][cols]
-            parts.append(np.ascontiguousarray(arr).tobytes())
-        parts.append(blob_section)
+    for kind in KIND_FIELDS:
+        sec, n_cols, _dropped = pack_kind_section(
+            kind, fields, payload_window_fn, payload_runs_fn)
+        sections.append(sec)
+        n_total += n_cols
     if n_total == 0:
         return None
-    return frame(MSGS, b"".join(parts))
+    return assemble_slice(src, sections)
 
 
 def unpack_slice(body: bytes, template: Dict[str, Tuple[np.dtype, tuple]],
@@ -444,6 +500,13 @@ def unpack_slice(body: bytes, template: Dict[str, Tuple[np.dtype, tuple]],
     materialized only where a consumer truly needs them (PayloadRun.
     materialize).  ``n_groups`` bounds-checks column ids so a corrupt or
     shape-mismatched frame can't scatter out of range.
+
+    A kind may appear in SEVERAL sections (striped packers and the
+    eager/deferred AE split each contribute one per frame —
+    :func:`assemble_slice`): their columns CONCATENATE in section order,
+    so the consumer's dense scatter is last-wins for a duplicated
+    (kind, group) lane, and a later section's payload run replaces an
+    earlier one for the same group.
     """
     end = len(body)
 
@@ -458,7 +521,9 @@ def unpack_slice(body: bytes, template: Dict[str, Tuple[np.dtype, tuple]],
     need(struct.calcsize("<IB"), 0)
     src, n_kinds = struct.unpack_from("<IB", body, 0)
     off = struct.calcsize("<IB")
-    out: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+    # field -> list of (cols, vals) parts, one per section carrying it;
+    # concatenated at the end (the single-section case stays zero-copy).
+    acc: Dict[str, List[Tuple[np.ndarray, np.ndarray]]] = {}
     payloads: Dict[int, PayloadRun] = {}
     for _ in range(n_kinds):
         need(struct.calcsize("<BI"), off)
@@ -475,7 +540,8 @@ def unpack_slice(body: bytes, template: Dict[str, Tuple[np.dtype, tuple]],
         if n_groups is not None and cols.size and int(cols.max()) >= n_groups:
             raise IOError("column id out of range (shape mismatch?)")
         off += 4 * n_cols
-        out[vfield] = (cols, np.ones(n_cols, bool))
+        acc.setdefault(vfield, []).append((cols, np.ones(n_cols, bool)))
+        sec_vals: Dict[str, np.ndarray] = {}
         for f in dfields:
             dt, trail = template[f]
             count = n_cols * int(np.prod(trail, dtype=np.int64)) \
@@ -484,10 +550,11 @@ def unpack_slice(body: bytes, template: Dict[str, Tuple[np.dtype, tuple]],
             vals = np.frombuffer(body, dt, count, off).reshape(
                 (n_cols,) + trail)
             off += vals.nbytes
-            out[f] = (cols, vals)
+            sec_vals[f] = vals
+            acc.setdefault(f, []).append((cols, vals))
         if kind == "ae":
-            prevs = out["ae_prev_idx"][1]
-            ns = out["ae_n"][1].astype(np.int64)
+            prevs = sec_vals["ae_prev_idx"]
+            ns = sec_vals["ae_n"].astype(np.int64)
             total = int(ns.sum())
             need(4 * total, off)
             lens = np.frombuffer(body, np.uint32, total, off)
@@ -506,6 +573,13 @@ def unpack_slice(body: bytes, template: Dict[str, Tuple[np.dtype, tuple]],
                         int(prev) + 1, body, starts[k:k + n], lens[k:k + n])
                     k += n
             off += int(ends[-1]) if total else 0
+    out: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+    for f, parts in acc.items():
+        if len(parts) == 1:
+            out[f] = parts[0]
+        else:
+            out[f] = (np.concatenate([p[0] for p in parts]),
+                      np.concatenate([p[1] for p in parts]))
     return src, out, payloads
 
 

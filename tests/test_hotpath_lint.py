@@ -1,0 +1,66 @@
+"""Hot-path lint (tier-1): the columnar rewrites that feed the striped
+host tier must not silently regress into per-group Python loops.
+
+The durable tick's cost model is O(groups-VISITED), not O(n_groups): a
+single reintroduced ``for g in range(n_groups)`` on the persist/send/
+apply/read path turns a 100k-group tick from microseconds back into
+hundreds of milliseconds and no functional test catches it — throughput
+regressions only show in benches.  This lint greps the hot methods'
+source for the banned idioms instead; sparse ``np.nonzero(...)``-driven
+``.tolist()`` loops over dirty subsets remain the approved pattern."""
+
+import inspect
+
+import rafting_tpu.runtime.node as node_mod
+from rafting_tpu.runtime.node import RaftNode
+
+# Methods on the per-tick hot path (persist / send / apply / read) plus
+# boot recovery.  Banned substrings mean "visits every group".
+HOT_METHODS = (
+    "_persist_prepare", "_persist_stage", "_sweep_rejections",
+    "_stash_outbox_sections", "_eager_send", "_flush_sends",
+    "_harvest_reads", "_serve_reads",
+    "_host_phase_serial", "_host_phase_striped",
+    "_recover_machines",
+)
+BANNED = (
+    "for g in range(",                # dense group walk
+    "range(self.cfg.n_groups)",       # dense group walk, spelled long
+    "np.arange(G).tolist()",          # dense walk via arange
+    "for g in list(self._reads_released",   # the pre-gate released walk
+)
+
+
+def test_hot_methods_have_no_dense_group_loops():
+    for name in HOT_METHODS:
+        src = inspect.getsource(getattr(RaftNode, name))
+        for pat in BANNED:
+            assert pat not in src, (
+                f"RaftNode.{name} reintroduced a dense per-group loop "
+                f"({pat!r}): visit np.nonzero(...) sparse subsets instead "
+                f"— see _persist_stage's wrote/mask idiom and "
+                f"_serve_reads' _rel_min columnar gate")
+
+
+def test_send_plane_uses_section_packing():
+    """Frames are built per-kind via pack_kind_section + assemble_slice
+    (the stash/eager/deferred split needs per-section control); a revived
+    whole-frame pack_slice call would re-couple eager and deferred
+    sections and break the durability-decoupled send plane."""
+    src = inspect.getsource(node_mod)
+    assert "pack_slice(" not in src, (
+        "runtime/node.py calls pack_slice — pack per-kind sections with "
+        "pack_kind_section and frame them with assemble_slice")
+    for name in ("_stash_outbox_sections", "_eager_send"):
+        assert "pack_kind_section" in \
+            inspect.getsource(getattr(RaftNode, name)), name
+
+
+def test_columnar_gates_present():
+    """Positive checks: the columnar structures the loops were replaced
+    WITH are still the mechanism (guards against a rewrite that drops
+    both the loop and the feature)."""
+    assert "groups_with_snapshots" in \
+        inspect.getsource(RaftNode._recover_machines)
+    assert "_rel_min" in inspect.getsource(RaftNode._serve_reads)
+    assert "_rel_min" in inspect.getsource(RaftNode._harvest_reads)

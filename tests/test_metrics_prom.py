@@ -114,6 +114,34 @@ def test_windowed_rates_cover_absolute_set_counters():
     assert not math.isnan(r)
 
 
+def test_host_tier_metrics_on_exposition(tmp_path):
+    """ISSUE 10 satellite: the striped host tier's observability — the
+    host_workers gauge, the per-worker stripe_busy_s histogram and the
+    eager_sends counter (rendered with the _total suffix, zero from boot
+    via its counter init) — all appear on /metrics and the page passes
+    the strict validator."""
+    from rafting_tpu.core.types import EngineConfig
+    from rafting_tpu.testkit.harness import LocalCluster
+
+    cfg = EngineConfig(n_groups=4, n_peers=3, log_slots=16, batch=4,
+                       max_submit=4, election_ticks=6, heartbeat_ticks=2,
+                       rpc_timeout_ticks=5)
+    c = LocalCluster(cfg, str(tmp_path), wal_shards=2, host_workers=2)
+    try:
+        c.wait_leader(0)
+        c.tick(3)
+        node = c.nodes[c.leader_of(0)]
+        text = node.metrics.render_prometheus()
+        validate_exposition(text)
+        assert "raft_host_workers 2" in text
+        assert "raft_eager_sends_total" in text
+        assert "raft_stripe_busy_s_bucket" in text
+        # The striped phase observed one busy sample per worker per tick.
+        assert node.metrics.histogram("stripe_busy_s").n >= 2
+    finally:
+        c.close()
+
+
 def test_membership_counters_on_metrics(tmp_path):
     """ISSUE 7 satellite: the membership-change and leadership-transfer
     counters render on /metrics from boot (zeros included), move with a
