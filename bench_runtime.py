@@ -53,12 +53,15 @@ def _shape(n_groups: int):
 
 def run(n_groups: int = 1024, rounds: int = 0, burst_n: int = 0,
         transport: str = "loopback", pipeline=None,
-        host_workers=None) -> dict:
+        host_workers=None, native=None) -> dict:
     """``pipeline``: True/False forces the durable pipeline on/off for
     every node; None uses the runtime default (RAFT_PIPELINE env if set,
     else on only for accelerator engine backends — see RaftNode).
     ``host_workers``: striped host tier width per node (None = the
-    runtime default, env RAFT_HOST_WORKERS else 1 = serial)."""
+    runtime default, env RAFT_HOST_WORKERS else 1 = serial).
+    ``native``: True/False pins the C++ stage_and_sync host tier on/off
+    via RAFT_NATIVE_HOST for the run; None = runtime auto-selection
+    (native whenever the .so loads)."""
     from rafting_tpu.core.types import EngineConfig, LEADER
     from rafting_tpu.testkit.fixtures import NullProvider
     from rafting_tpu.testkit.harness import LocalCluster
@@ -81,9 +84,19 @@ def run(n_groups: int = 1024, rounds: int = 0, burst_n: int = 0,
         max_submit=int(os.environ.get("BENCH_RT_SUBMIT", "32")),
         election_ticks=10, heartbeat_ticks=3, rpc_timeout_ticks=8)
     root = tempfile.mkdtemp(prefix="bench-runtime-")
-    c = LocalCluster(cfg, root, provider_factory=NullProvider, seed=0,
-                     transport=transport, pipeline=pipeline,
-                     host_workers=host_workers)
+    env_prev = os.environ.get("RAFT_NATIVE_HOST")
+    if native is not None:
+        os.environ["RAFT_NATIVE_HOST"] = "1" if native else "0"
+    try:
+        c = LocalCluster(cfg, root, provider_factory=NullProvider, seed=0,
+                         transport=transport, pipeline=pipeline,
+                         host_workers=host_workers)
+    finally:
+        if native is not None:
+            if env_prev is None:
+                os.environ.pop("RAFT_NATIVE_HOST", None)
+            else:
+                os.environ["RAFT_NATIVE_HOST"] = env_prev
     payload = b"x" * 64
     burst = [payload] * burst_n
 
@@ -175,6 +188,9 @@ def run(n_groups: int = 1024, rounds: int = 0, burst_n: int = 0,
             "rounds": rounds,
             "pipeline": bool(slow.pipeline),
             "host_workers": int(slow._w_eff),
+            "native_host": bool(slow._native_host),
+            "native_workers": int(slow._w_native) if slow._native_host
+                              else 0,
             "wal_shards": getattr(getattr(slow.store, "wal", None),
                                   "n_shards", 1),
             "tick_latency": lat,
@@ -254,3 +270,34 @@ if __name__ == "__main__":
                     "striped_stages_mean_s": striped["tick_stages_mean_s"],
                     "serial_stages_mean_s": base["tick_stages_mean_s"],
                 }), flush=True)
+        if os.environ.get("BENCH_NATIVE", "") == "1":
+            # Native-vs-Python host tier A/B at the same scale: the C++
+            # stage_and_sync path (GIL released, real OS threads) against
+            # the pure-Python serial staging loop.  Both runs print their
+            # own JSON line; the comparison line carries the per-backend
+            # wal/fsync/send stage means — the tentpole's acceptance
+            # metric is mean wal_s, not just the commits/sec headline
+            # (which also folds in scan-wait and apply cost that the
+            # native tier doesn't touch).
+            py = run(n_groups=n, transport=transport, native=False,
+                     host_workers=1)
+            print(json.dumps(py), flush=True)
+            nat = run(n_groups=n, transport=transport, native=True,
+                      host_workers=4)
+            print(json.dumps(nat), flush=True)
+
+            def _st(d, k):
+                return d["tick_stages_mean_s"].get(k, 0.0)
+            print(json.dumps({
+                "metric": f"native host tier wal speedup @{n} groups "
+                          f"(W={nat['native_workers']}, {transport})",
+                "value": round(_st(py, "wal_s") /
+                               max(_st(nat, "wal_s"), 1e-9), 3),
+                "unit": "x (python wal_s / native wal_s, mean per tick)",
+                "native_commits_per_sec": nat["value"],
+                "python_commits_per_sec": py["value"],
+                "native": {k: _st(nat, k)
+                           for k in ("wal_s", "fsync_s", "send_s")},
+                "python": {k: _st(py, k)
+                           for k in ("wal_s", "fsync_s", "send_s")},
+            }), flush=True)

@@ -28,18 +28,23 @@
 // handle.
 
 #include <algorithm>
+#include <atomic>
 #include <cerrno>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
+#include <ctime>
 #include <map>
 #include <string>
+#include <thread>
 #include <unordered_map>
 #include <unordered_set>
 #include <vector>
 
 #include <dirent.h>
 #include <fcntl.h>
+#include <sys/mman.h>
 #include <sys/stat.h>
 #include <sys/types.h>
 #include <unistd.h>
@@ -302,6 +307,129 @@ void maybe_rotate(Wal& w) {
   open_segment(w, w.seg_id + 1, true);
 }
 
+double mono_s() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (double)ts.tv_sec + 1e-9 * (double)ts.tv_nsec;
+}
+
+// Record-level bodies shared by the serial entry points and the native host
+// tier (wal_stage_and_sync): one implementation per record type keeps the
+// two paths byte-identical by construction.
+void do_truncate(Wal& w, uint32_t group, uint64_t from) {
+  std::vector<uint8_t> body;
+  body.push_back(kTruncate);
+  put_u32(body, group);
+  put_u64(body, from);
+  w.groups[group].drop_suffix(from);
+  frame(w.buf, body);
+  maybe_rotate(w);
+}
+
+void do_milestone(Wal& w, uint32_t group, uint64_t index, int64_t term) {
+  std::vector<uint8_t> body;
+  body.push_back(kMilestone);
+  put_u32(body, group);
+  put_u64(body, index);
+  put_u64(body, (uint64_t)term);
+  auto& gs = w.groups[group];
+  if ((int64_t)index >= gs.floor) {  // mirror apply_body's replay semantics
+    gs.floor = (int64_t)index;
+    gs.floor_term = term;
+    gs.drop_prefix(index);
+    if (gs.tail < gs.floor) gs.tail = gs.floor;
+  }
+  frame(w.buf, body);
+  maybe_rotate(w);
+}
+
+// Shared bulk-entry staging loop (hot path): records are framed IN PLACE
+// into the write buffer (no per-entry body vector; the CRC chains over
+// header and payload without a copy) and the in-memory index exploits the
+// staging order — entries arrive as ascending contiguous runs per group, so
+// after one drop_suffix at a run's head every insert is an O(1) hinted
+// emplace at map end instead of an O(log n) search.  `ptr_at(i)` resolves
+// row i's payload bytes, letting the blob-offset ABI (wal_append_entries)
+// and the raw-pointer ABI (wal_stage_and_sync) share one byte-identical
+// implementation.
+template <typename PtrAt>
+void stage_rows_impl(Wal& w, uint64_t n, const uint32_t* groups,
+                     const uint64_t* idxs, const int64_t* terms,
+                     const uint32_t* lens, PtrAt ptr_at) {
+  uint64_t total = 0;
+  for (uint64_t i = 0; i < n; i++) total += 37u + (uint64_t)lens[i];
+  w.buf.reserve(w.buf.size() + total);
+  uint8_t hdr[25];
+  hdr[0] = kEntry;
+  GroupState* gs = nullptr;
+  uint32_t cur_g = 0;
+  uint64_t prev_idx = 0;
+  bool run_live = false;
+  for (uint64_t i = 0; i < n; i++) {
+    const uint32_t g = groups[i];
+    const uint64_t idx = idxs[i];
+    const uint32_t plen = lens[i];
+    const uint8_t* p = ptr_at(i);
+    // body header (little-endian, layout matches wal_append_entry)
+    hdr[1] = (uint8_t)g; hdr[2] = (uint8_t)(g >> 8);
+    hdr[3] = (uint8_t)(g >> 16); hdr[4] = (uint8_t)(g >> 24);
+    for (int b = 0; b < 8; b++) hdr[5 + b] = (uint8_t)(idx >> (8 * b));
+    const uint64_t t = (uint64_t)terms[i];
+    for (int b = 0; b < 8; b++) hdr[13 + b] = (uint8_t)(t >> (8 * b));
+    hdr[21] = (uint8_t)plen; hdr[22] = (uint8_t)(plen >> 8);
+    hdr[23] = (uint8_t)(plen >> 16); hdr[24] = (uint8_t)(plen >> 24);
+    const uint32_t crc = crc32(p, plen, crc32(hdr, 25));
+    put_u32(w.buf, kMagic);
+    put_u32(w.buf, 25u + plen);
+    put_u32(w.buf, crc);
+    const uint64_t body_off = w.seg_off + w.buf.size();
+    w.buf.insert(w.buf.end(), hdr, hdr + 25);
+    if (plen) w.buf.insert(w.buf.end(), p, p + plen);
+    // index update (mirrors wal_append_entry/replay semantics)
+    if (gs == nullptr || g != cur_g) {
+      gs = &w.groups[g];
+      cur_g = g;
+      run_live = false;
+    }
+    if (run_live && idx == prev_idx + 1) {
+      gs->entries.emplace_hint(gs->entries.end(), idx,
+                               EntryRef{terms[i], w.seg_id, body_off + 25,
+                                        plen});
+    } else {
+      gs->drop_suffix(idx);
+      gs->entries[idx] = EntryRef{terms[i], w.seg_id, body_off + 25, plen};
+      run_live = true;
+    }
+    gs->tail = (int64_t)idx;
+    prev_idx = idx;
+    if (w.seg_off + w.buf.size() >= w.segment_bytes) {
+      maybe_rotate(w);
+      gs = nullptr;  // rotation does not move the map, but re-resolve for
+                     // clarity; the payload refs already recorded keep
+                     // their (seg, off) and are unaffected.
+    }
+  }
+}
+
+// Split [0, n_items) into one contiguous chunk per worker; worker 0 runs
+// inline on the calling thread.  `f(c0, c1)` must be thread-safe for
+// disjoint ranges.
+template <typename F>
+void run_ranges(uint32_t n_workers, uint64_t n_items, F&& f) {
+  if (n_workers <= 1 || n_items < (uint64_t)n_workers * 4) {
+    f((uint64_t)0, n_items);
+    return;
+  }
+  uint64_t chunk = (n_items + n_workers - 1) / n_workers;
+  std::vector<std::thread> ts;
+  for (uint64_t c0 = chunk; c0 < n_items; c0 += chunk) {
+    uint64_t c1 = std::min(n_items, c0 + chunk);
+    ts.emplace_back([&f, c0, c1]() { f(c0, c1); });
+  }
+  f((uint64_t)0, chunk);
+  for (auto& t : ts) t.join();
+}
+
 }  // namespace
 
 extern "C" {
@@ -382,32 +510,11 @@ void wal_append_stable(void* h, uint32_t group, int64_t term, int64_t ballot) {
 }
 
 void wal_truncate(void* h, uint32_t group, uint64_t from) {
-  Wal* w = (Wal*)h;
-  std::vector<uint8_t> body;
-  body.push_back(kTruncate);
-  put_u32(body, group);
-  put_u64(body, from);
-  w->groups[group].drop_suffix(from);
-  frame(w->buf, body);
-  maybe_rotate(*w);
+  do_truncate(*(Wal*)h, group, from);
 }
 
 void wal_milestone(void* h, uint32_t group, uint64_t index, int64_t term) {
-  Wal* w = (Wal*)h;
-  std::vector<uint8_t> body;
-  body.push_back(kMilestone);
-  put_u32(body, group);
-  put_u64(body, index);
-  put_u64(body, (uint64_t)term);
-  auto& gs = w->groups[group];
-  if ((int64_t)index >= gs.floor) {  // mirror apply_body's replay semantics
-    gs.floor = (int64_t)index;
-    gs.floor_term = term;
-    gs.drop_prefix(index);
-    if (gs.tail < gs.floor) gs.tail = gs.floor;
-  }
-  frame(w->buf, body);
-  maybe_rotate(*w);
+  do_milestone(*(Wal*)h, group, index, term);
 }
 
 // Group destroyed (admin lifecycle): journal a RESET so the lane's entire
@@ -583,70 +690,14 @@ uint64_t wal_export_state(void* h, uint32_t G, uint32_t L,
 // Batched append: n entries across any mix of groups in ONE call, payload
 // bytes concatenated in `payloads` at offsets `offs` (the host runtime
 // stages a whole tick's writes and crosses the ctypes boundary once).
-// Hot path of the durable tier: records are framed IN PLACE into the
-// write buffer (no per-entry body vector; the CRC chains over header and
-// payload without a copy) and the in-memory index exploits the staging
-// order — entries arrive as ascending contiguous runs per group, so after
-// one drop_suffix at a run's head every insert is an O(1) hinted
-// emplace at map end instead of an O(log n) search.
+// Hot path of the durable tier; see stage_rows_impl for the framing and
+// index discipline.
 void wal_append_entries(void* h, uint64_t n, const uint32_t* groups,
                         const uint64_t* idxs, const int64_t* terms,
                         const uint8_t* payloads, const uint64_t* offs,
                         const uint32_t* lens) {
-  Wal* w = (Wal*)h;
-  uint64_t total = 0;
-  for (uint64_t i = 0; i < n; i++) total += 37u + (uint64_t)lens[i];
-  w->buf.reserve(w->buf.size() + total);
-  uint8_t hdr[25];
-  hdr[0] = kEntry;
-  GroupState* gs = nullptr;
-  uint32_t cur_g = 0;
-  uint64_t prev_idx = 0;
-  bool run_live = false;
-  for (uint64_t i = 0; i < n; i++) {
-    const uint32_t g = groups[i];
-    const uint64_t idx = idxs[i];
-    const uint32_t plen = lens[i];
-    const uint8_t* p = payloads + offs[i];
-    // body header (little-endian, layout matches wal_append_entry)
-    hdr[1] = (uint8_t)g; hdr[2] = (uint8_t)(g >> 8);
-    hdr[3] = (uint8_t)(g >> 16); hdr[4] = (uint8_t)(g >> 24);
-    for (int b = 0; b < 8; b++) hdr[5 + b] = (uint8_t)(idx >> (8 * b));
-    const uint64_t t = (uint64_t)terms[i];
-    for (int b = 0; b < 8; b++) hdr[13 + b] = (uint8_t)(t >> (8 * b));
-    hdr[21] = (uint8_t)plen; hdr[22] = (uint8_t)(plen >> 8);
-    hdr[23] = (uint8_t)(plen >> 16); hdr[24] = (uint8_t)(plen >> 24);
-    const uint32_t crc = crc32(p, plen, crc32(hdr, 25));
-    put_u32(w->buf, kMagic);
-    put_u32(w->buf, 25u + plen);
-    put_u32(w->buf, crc);
-    const uint64_t body_off = w->seg_off + w->buf.size();
-    w->buf.insert(w->buf.end(), hdr, hdr + 25);
-    if (plen) w->buf.insert(w->buf.end(), p, p + plen);
-    // index update (mirrors wal_append_entry/replay semantics)
-    if (gs == nullptr || g != cur_g) {
-      gs = &w->groups[g];
-      cur_g = g;
-      run_live = false;
-    }
-    if (run_live && idx == prev_idx + 1) {
-      gs->entries.emplace_hint(gs->entries.end(), idx,
-                               EntryRef{terms[i], w->seg_id, body_off + 25,
-                                        plen});
-    } else {
-      gs->drop_suffix(idx);
-      gs->entries[idx] = EntryRef{terms[i], w->seg_id, body_off + 25, plen};
-      run_live = true;
-    }
-    gs->tail = (int64_t)idx;
-    prev_idx = idx;
-    if (w->seg_off + w->buf.size() >= w->segment_bytes) {
-      maybe_rotate(*w);
-      gs = nullptr;  // rotation does not move the map, but re-resolve for
-                     // clarity; the payload refs already recorded keep
-                     // their (seg, off) and are unaffected.
-    }
-  }
+  stage_rows_impl(*(Wal*)h, n, groups, idxs, terms, lens,
+                  [&](uint64_t i) { return payloads + offs[i]; });
 }
 
 // Rewrite all live state into a fresh segment and delete older segments —
@@ -901,5 +952,241 @@ void wal_gc_abort(void* h) {
 }
 
 const char* wal_error(void* h) { return ((Wal*)h)->err.c_str(); }
+
+// ---------------------------------------------------------------------------
+// Native host tier: the per-stripe persist hot loop behind ONE ctypes call.
+//
+// The striped Python worker pool (runtime/node.py _host_phase_striped) tops
+// out near 1.15x because its workers only overlap the GIL-released syscalls;
+// the staging loops themselves serialize on the interpreter.  These entry
+// points move the whole stage → fsync → pack pipeline into real OS threads:
+// ctypes releases the GIL for the duration of the call, worker k owns WAL
+// shards `s % n_workers == k` (the exact ownership map of the Python pool,
+// so per-shard record order — and therefore segment bytes — is identical),
+// and the tick thread becomes pure orchestration.
+//
+// Handles must be distinct single-threaded engines (one per shard); within
+// a call each shard is touched by exactly one worker, and no other thread
+// may use the handles concurrently — the same contract the Python striped
+// pool already upholds.
+// ---------------------------------------------------------------------------
+
+// Stage one tick's durable work across all shards and (optionally) fsync.
+//
+// Entry rows are pre-sorted by shard (stable, so the caller's per-group
+// ascending contiguous runs survive); `row_off[s]..row_off[s+1]` is shard
+// s's slice.  Payload bytes live at caller-supplied absolute addresses
+// (`ptrs`, one per row — numpy arena views handed straight through, no blob
+// join).  Truncations (`t*`) and milestones (`f*`) use the same per-shard
+// CSR layout and are applied AFTER the shard's entries, matching the serial
+// path's record order (stable records are staged by the caller before this
+// call).  `do_sync=0` stages without the fsync barrier — the crash-window
+// tests carve the torn-tail window with it.  Out-params receive the max
+// per-worker stage and fsync wall times.
+int wal_stage_and_sync(void** handles, uint32_t n_shards, uint32_t n_workers,
+                       const uint64_t* row_off, const uint32_t* groups,
+                       const uint64_t* idxs, const int64_t* terms,
+                       const uint64_t* ptrs, const uint32_t* lens,
+                       const uint64_t* trow_off, const uint32_t* tgroups,
+                       const uint64_t* tfrom,
+                       const uint64_t* frow_off, const uint32_t* fgroups,
+                       const uint64_t* fidx, const int64_t* fterm,
+                       int do_sync, double* stage_s, double* fsync_s) {
+  if (!handles || n_shards == 0) return -1;
+  if (n_workers == 0) n_workers = 1;
+  if (n_workers > n_shards) n_workers = n_shards;
+  std::vector<double> st(n_workers, 0.0), fs(n_workers, 0.0);
+  std::vector<int> rc(n_workers, 0);
+  auto work = [&](uint32_t k) {
+    const double t0 = mono_s();
+    for (uint32_t s = k; s < n_shards; s += n_workers) {
+      Wal& w = *(Wal*)handles[s];
+      const uint64_t r0 = row_off[s], r1 = row_off[s + 1];
+      stage_rows_impl(w, r1 - r0, groups + r0, idxs + r0, terms + r0,
+                      lens + r0,
+                      [&, r0](uint64_t i) {
+                        return (const uint8_t*)(uintptr_t)ptrs[r0 + i];
+                      });
+      for (uint64_t i = trow_off[s]; i < trow_off[s + 1]; i++)
+        do_truncate(w, tgroups[i], tfrom[i]);
+      for (uint64_t i = frow_off[s]; i < frow_off[s + 1]; i++)
+        do_milestone(w, fgroups[i], fidx[i], fterm[i]);
+    }
+    const double t1 = mono_s();
+    st[k] = t1 - t0;
+    if (do_sync) {
+      for (uint32_t s = k; s < n_shards; s += n_workers) {
+        Wal& w = *(Wal*)handles[s];
+        if (!flush_buf(w)) { rc[k] = -1; continue; }
+        if (::fsync(w.fd) != 0) {
+          w.err = std::string("fsync: ") + std::strerror(errno);
+          rc[k] = -1;
+        }
+      }
+      fs[k] = mono_s() - t1;
+    }
+  };
+  if (n_workers == 1) {
+    work(0);
+  } else {
+    std::vector<std::thread> ts;
+    ts.reserve(n_workers - 1);
+    for (uint32_t k = 1; k < n_workers; k++) ts.emplace_back(work, k);
+    work(0);
+    for (auto& t : ts) t.join();
+  }
+  if (stage_s) *stage_s = *std::max_element(st.begin(), st.end());
+  if (fsync_s) *fsync_s = *std::max_element(fs.begin(), fs.end());
+  for (int r : rc)
+    if (r != 0) return -1;
+  return 0;
+}
+
+namespace {
+
+// Per-call mmap cache for flushed segment files (pack reads cluster in the
+// open segment plus at most a handful of predecessors).
+struct SegMap {
+  uint8_t* p = nullptr;
+  uint64_t size = 0;
+  int fd = -1;
+};
+using SegMapCache = std::unordered_map<uint64_t, SegMap>;
+
+bool copy_payload(Wal* w, uint32_t shard, const EntryRef& r, uint8_t* dst,
+                  SegMapCache& maps) {
+  if (r.len == 0) return true;
+  if (r.seg == w->seg_id && r.off >= w->seg_off) {
+    // Still in the unflushed buffer.  Safe to read concurrently: pack runs
+    // strictly between staging phases, so no thread mutates the buffer.
+    const size_t boff = (size_t)(r.off - w->seg_off);
+    if (boff + r.len > w->buf.size()) return false;
+    std::memcpy(dst, w->buf.data() + boff, r.len);
+    return true;
+  }
+  const uint64_t key = ((uint64_t)shard << 32) | r.seg;
+  auto it = maps.find(key);
+  if (it == maps.end()) {
+    SegMap sm;
+    sm.fd = ::open(seg_path(*w, r.seg).c_str(), O_RDONLY);
+    if (sm.fd < 0) return false;
+    struct stat stt;
+    if (::fstat(sm.fd, &stt) == 0) sm.size = (uint64_t)stt.st_size;
+    if (sm.size) {
+      void* mp = ::mmap(nullptr, sm.size, PROT_READ, MAP_SHARED, sm.fd, 0);
+      if (mp != MAP_FAILED) sm.p = (uint8_t*)mp;
+    }
+    it = maps.emplace(key, sm).first;
+  }
+  const SegMap& sm = it->second;
+  if (sm.p && r.off + r.len <= sm.size) {
+    std::memcpy(dst, sm.p + r.off, r.len);
+    return true;
+  }
+  return ::pread(sm.fd, dst, r.len, (off_t)r.off) == (ssize_t)r.len;
+}
+
+void drop_segmaps(SegMapCache& maps) {
+  for (auto& kv : maps) {
+    if (kv.second.p) ::munmap(kv.second.p, kv.second.size);
+    if (kv.second.fd >= 0) ::close(kv.second.fd);
+  }
+  maps.clear();
+}
+
+// Total payload bytes for entries [start, start+n) of group g, or -1 if the
+// range is not fully present (column gets dropped, exactly as the Python
+// packer drops a column whose runs/window cannot cover it).
+int64_t col_bytes(Wal* w, uint32_t g, uint64_t start, uint32_t n) {
+  if (n == 0) return 0;
+  auto git = w->groups.find(g);
+  if (git == w->groups.end()) return -1;
+  auto& ents = git->second.entries;
+  auto it = ents.find(start);
+  uint64_t sum = 0;
+  for (uint32_t k = 0; k < n; k++, ++it) {
+    if (it == ents.end() || it->first != start + k) return -1;
+    sum += it->second.len;
+  }
+  return (int64_t)sum;
+}
+
+}  // namespace
+
+// Pack the AppendEntries payload blob for n_cols columns: the u32 length
+// vector for every kept column's entries, then the payload bytes — the
+// byte-exact layout of codec.pack_kind_section's `blob_section`.  Columns
+// whose range is absent get ok_out[c]=0 and contribute nothing (the caller
+// drops/defers them like the Python packer).  Payloads are resolved from
+// the engines' own indexes (unflushed buffer or mmap'd segments) with
+// chunk-parallel workers; read-only over the maps, so safe to run while no
+// staging is in flight.  Returns the malloc'd blob via *out_ptr (free with
+// wal_buf_free) and its total length, or -1 on I/O failure (caller falls
+// back to the Python pack path).
+int64_t wal_pack_ae(void** handles, uint32_t n_shards, uint32_t n_workers,
+                    uint64_t n_cols, const uint32_t* gs,
+                    const uint64_t* starts, const uint32_t* ns,
+                    uint8_t* ok_out, uint8_t** out_ptr) {
+  if (!handles || n_shards == 0) return -1;
+  *out_ptr = nullptr;
+  std::vector<uint64_t> pay(n_cols, 0);
+  run_ranges(n_workers, n_cols, [&](uint64_t c0, uint64_t c1) {
+    for (uint64_t c = c0; c < c1; c++) {
+      Wal* w = (Wal*)handles[gs[c] % n_shards];
+      const int64_t b = col_bytes(w, gs[c], starts[c], ns[c]);
+      ok_out[c] = b >= 0 ? 1 : 0;
+      pay[c] = b >= 0 ? (uint64_t)b : 0;
+    }
+  });
+  // Column offsets: kept columns' length words first, then their payloads.
+  std::vector<uint64_t> loff(n_cols, 0), poff(n_cols, 0);
+  uint64_t lens_total = 0, pay_total = 0;
+  for (uint64_t c = 0; c < n_cols; c++) {
+    if (!ok_out[c]) continue;
+    loff[c] = lens_total;
+    lens_total += 4ull * ns[c];
+    poff[c] = pay_total;
+    pay_total += pay[c];
+  }
+  const uint64_t total = lens_total + pay_total;
+  uint8_t* out = (uint8_t*)std::malloc(total ? total : 1);
+  if (!out) return -1;
+  std::atomic<bool> fail(false);
+  run_ranges(n_workers, n_cols, [&](uint64_t c0, uint64_t c1) {
+    SegMapCache maps;
+    for (uint64_t c = c0; c < c1 && !fail.load(std::memory_order_relaxed);
+         c++) {
+      if (!ok_out[c] || ns[c] == 0) continue;  // heartbeats carry no bytes
+      Wal* w = (Wal*)handles[gs[c] % n_shards];
+      auto git = w->groups.find(gs[c]);
+      if (git == w->groups.end()) { fail.store(true); break; }
+      auto it = git->second.entries.find(starts[c]);
+      uint8_t* lp = out + loff[c];
+      uint8_t* pp = out + lens_total + poff[c];
+      for (uint32_t k = 0; k < ns[c]; k++, ++it) {
+        if (it == git->second.entries.end() ||
+            it->first != starts[c] + k) { fail.store(true); break; }
+        const EntryRef& r = it->second;
+        lp[0] = (uint8_t)r.len; lp[1] = (uint8_t)(r.len >> 8);
+        lp[2] = (uint8_t)(r.len >> 16); lp[3] = (uint8_t)(r.len >> 24);
+        lp += 4;
+        if (!copy_payload(w, gs[c] % n_shards, r, pp, maps)) {
+          fail.store(true);
+          break;
+        }
+        pp += r.len;
+      }
+    }
+    drop_segmaps(maps);
+  });
+  if (fail.load()) {
+    std::free(out);
+    return -1;
+  }
+  *out_ptr = out;
+  return (int64_t)total;
+}
+
+void wal_buf_free(uint8_t* p) { std::free(p); }
 
 }  // extern "C"

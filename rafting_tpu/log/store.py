@@ -94,7 +94,9 @@ class LogStore:
         it covers a small fraction of the frame it pins."""
         n = len(run.lens)
         if not n:
-            return run
+            # A fully trimmed run must not keep its (possibly frame-sized)
+            # exporter alive through the buf reference.
+            return PayloadRun(run.start, b"", run.offs[:0], run.lens[:0])
         frame = cls._frame_bytes(run.buf)
         if frame < cls._COMPACT_MIN_FRAME:
             return run
@@ -116,8 +118,11 @@ class LogStore:
         if runs and runs[-1].end >= run.start:
             r = runs[-1]
             keep = run.start - r.start
-            runs[-1] = PayloadRun(r.start, r.buf, r.offs[:keep],
-                                  r.lens[:keep])
+            # Compact like every other trim site: an overwrite that lops a
+            # run down to a sliver must not leave the sliver pinning a
+            # frame-sized buffer (ROADMAP carry-forward, log/store.py:55).
+            runs[-1] = self._maybe_compact(
+                PayloadRun(r.start, r.buf, r.offs[:keep], r.lens[:keep]))
         starts.append(run.start)
         runs.append(run)
 
@@ -237,6 +242,116 @@ class LogStore:
             g_all, i_all, t_all,
             b"".join(sp[2] for sp in spans), offs_all, lens_all)
 
+    # -- native host tier ----------------------------------------------------
+
+    @property
+    def can_stage_native(self) -> bool:
+        """True when the WAL backend exposes the native host tier (every
+        shard is a native engine and the .so exports wal_stage_and_sync)."""
+        return bool(getattr(self.wal, "can_stage_native", False))
+
+    def stage_and_sync(self, spans: Sequence[tuple],
+                       trunc_gs, trunc_tails,
+                       floor_gs, floor_idxs, floor_terms, *,
+                       workers: int = 1, sync: bool = True):
+        """Native-tier variant of the tick's span/truncate/floor staging +
+        fsync: ONE ctypes call stages every shard with real OS threads.
+
+        Spans use :meth:`append_spans`'s currency; truncations are
+        ``truncate_to`` rows the CALLER pre-filtered with the same
+        durable-tail guard (the record emitted is ``truncate(g, tail+1)``);
+        floors are ``set_floor`` rows (the wal-floor guard is re-checked
+        here).  Python-side effects — membership sidecar, payload-run
+        cache, durable-tail map — are applied in the exact order of the
+        serial path; only the WAL record staging and the fsync barrier
+        cross into C.  Entry payloads are handed over as raw per-span base
+        pointers (``spans`` must stay alive for the duration of the call).
+        Returns ``(stage_s, fsync_s)``."""
+        n_spans = len(spans)
+        counts = np.empty(n_spans, np.int64)
+        gs_v = np.empty(n_spans, np.int64)
+        starts_v = np.empty(n_spans, np.int64)
+        base_ptrs = np.empty(n_spans, np.uint64)
+        j = 0
+        for sp in spans:
+            gs_v[j] = sp[0]
+            starts_v[j] = sp[1]
+            counts[j] = len(sp[3])
+            base_ptrs[j] = np.frombuffer(sp[2], np.uint8).ctypes.data
+            j += 1
+        total = int(counts.sum()) if n_spans else 0
+        ends = np.cumsum(counts)
+        span_pos = ends - counts
+        g_all = np.repeat(gs_v, counts).astype(np.uint32)
+        i_all = (np.arange(total, dtype=np.int64)
+                 + np.repeat(starts_v - span_pos, counts)).astype(np.uint64)
+        lens_all = np.empty(total, np.uint32)
+        t_all = np.empty(total, np.int64)
+        pos = 0
+        for sp in spans:
+            cnt = len(sp[3])
+            sl = slice(pos, pos + cnt)
+            lens_all[sl] = sp[3]
+            t_all[sl] = sp[4]
+            pos += cnt
+        offs_all = np.zeros(total, np.uint64)
+        if total > 1:
+            np.cumsum(lens_all[:-1].astype(np.uint64), out=offs_all[1:])
+        # Per-entry payload ADDRESSES: span base pointer + offset within
+        # the span — the native side reads the arena views in place, no
+        # blob join, no copy.
+        ptr_all = (np.repeat(base_ptrs, counts)
+                   + (offs_all - np.repeat(offs_all[span_pos]
+                                           if n_spans else offs_all,
+                                           counts)))
+        # Python-side bookkeeping in serial-path order: runs first
+        # (append), then truncations, then floors.
+        pos = 0
+        dt = self._durable_tail
+        for sp in spans:
+            g, start = sp[0], sp[1]
+            cnt = len(sp[3])
+            offs = offs_all[pos:pos + cnt] - offs_all[pos]
+            self._add_run(g, PayloadRun(start, sp[2], offs, sp[3]))
+            pos += cnt
+            tail_new = start + cnt - 1
+            if tail_new > dt.get(g, 0):
+                dt[g] = tail_new
+        t_from = np.asarray(trunc_tails, np.uint64) + np.uint64(1)
+        for g, tail in zip(np.asarray(trunc_gs).tolist(),
+                           np.asarray(trunc_tails).tolist()):
+            g, tail = int(g), int(tail)
+            self.conf.truncate(g, tail)
+            dt[g] = tail
+            self._trim_cache_tail(g, tail)
+        f_keep = []
+        for k, (g, index) in enumerate(zip(np.asarray(floor_gs).tolist(),
+                                           np.asarray(floor_idxs).tolist())):
+            g, index = int(g), int(index)
+            self.conf.set_floor(g, index, 0)
+            if index <= self.wal.floor(g):
+                continue   # same guard as set_floor: no record staged
+            f_keep.append(k)
+            self._trim_cache_floor(g, index)
+            dt[g] = max(dt.get(g, 0), index)
+        f_keep = np.asarray(f_keep, np.int64)
+        f_gs = np.asarray(floor_gs, np.uint32)[f_keep]
+        f_idx = np.asarray(floor_idxs, np.uint64)[f_keep]
+        f_term = np.asarray(floor_terms, np.int64)[f_keep]
+        return self.wal.stage_and_sync(
+            g_all, i_all, t_all, ptr_all, lens_all,
+            np.asarray(trunc_gs, np.uint32), t_from,
+            f_gs, f_idx, f_term, workers=workers, sync=sync)
+
+    def pack_ae_blob(self, cols, starts, ns, *, workers: int = 1):
+        """Native AppendEntries blob pack (codec payload_blob_fn hook):
+        ``(ok_mask, blob)`` or None when the native tier is unavailable
+        (codec falls back to its Python per-column loop)."""
+        pack = getattr(self.wal, "pack_ae", None)
+        if pack is None:
+            return None
+        return pack(cols, starts, ns, workers=workers)
+
     def put_conf(self, g: int, idx: int, word: int) -> None:
         """Record a config entry (§6 membership plane) so recovery can
         rebuild the conf ring; durable at the next sync()."""
@@ -253,6 +368,39 @@ class LogStore:
         """{g: (floor_word, {idx: word})} — recovery input."""
         return self.conf.export()
 
+    def _trim_cache_tail(self, g: int, tail: int) -> None:
+        """Drop cached entries above ``tail`` (suffix truncation)."""
+        ent = self._cache.get(g)
+        if ent:
+            starts, runs = ent
+            while starts and starts[-1] > tail:
+                starts.pop()
+                runs.pop()
+            if runs and runs[-1].end > tail:
+                r = runs[-1]
+                keep = tail - r.start + 1
+                runs[-1] = self._maybe_compact(
+                    PayloadRun(r.start, r.buf, r.offs[:keep],
+                               r.lens[:keep]))
+
+    def _trim_cache_floor(self, g: int, index: int) -> None:
+        """Drop cached entries at/under ``index`` (compaction floor)."""
+        ent = self._cache.get(g)
+        if ent:
+            starts, runs = ent
+            drop = 0
+            while drop < len(runs) and runs[drop].end <= index:
+                drop += 1
+            if drop:
+                del starts[:drop]
+                del runs[:drop]
+            if runs and runs[0].start <= index:
+                r = runs[0]
+                k = index + 1 - r.start
+                runs[0] = self._maybe_compact(
+                    PayloadRun(index + 1, r.buf, r.offs[k:], r.lens[k:]))
+                starts[0] = index + 1
+
     def truncate_to(self, g: int, tail: int) -> None:
         """Ensure the durable suffix beyond `tail` dies (conflict/snapshot
         discard).  No-op if the durable tail is already <= tail."""
@@ -260,18 +408,7 @@ class LogStore:
         if self._durable_tail.get(g, self.wal.tail(g)) > tail:
             self.wal.truncate(g, tail + 1)
             self._durable_tail[g] = tail
-            ent = self._cache.get(g)
-            if ent:
-                starts, runs = ent
-                while starts and starts[-1] > tail:
-                    starts.pop()
-                    runs.pop()
-                if runs and runs[-1].end > tail:
-                    r = runs[-1]
-                    keep = tail - r.start + 1
-                    runs[-1] = self._maybe_compact(
-                        PayloadRun(r.start, r.buf, r.offs[:keep],
-                                   r.lens[:keep]))
+            self._trim_cache_tail(g, tail)
 
     def put_stable(self, g: int, term: int, ballot: int) -> None:
         if self._stable.get(g) == (term, ballot):
@@ -302,21 +439,7 @@ class LogStore:
         if index <= self.wal.floor(g):
             return
         self.wal.milestone(g, index, term)
-        ent = self._cache.get(g)
-        if ent:
-            starts, runs = ent
-            drop = 0
-            while drop < len(runs) and runs[drop].end <= index:
-                drop += 1
-            if drop:
-                del starts[:drop]
-                del runs[:drop]
-            if runs and runs[0].start <= index:
-                r = runs[0]
-                k = index + 1 - r.start
-                runs[0] = self._maybe_compact(
-                    PayloadRun(index + 1, r.buf, r.offs[k:], r.lens[k:]))
-                starts[0] = index + 1
+        self._trim_cache_floor(g, index)
         self._durable_tail[g] = max(self._durable_tail.get(g, 0), index)
 
     def reset_group(self, g: int) -> None:

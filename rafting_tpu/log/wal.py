@@ -54,7 +54,8 @@ def _build_native() -> Optional[str]:
                 and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
             return None
         r = subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO],
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+             _SRC, "-o", _SO],
             capture_output=True, text=True, timeout=120)
         if r.returncode != 0:
             return r.stderr[-2000:]
@@ -130,12 +131,119 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.wal_gc_finish.restype = ctypes.c_int
         lib.wal_gc_abort.argtypes = [ctypes.c_void_p]
         lib.wal_gc_abort.restype = None
+        lib.wal_error.argtypes = [ctypes.c_void_p]
+        lib.wal_error.restype = ctypes.c_char_p
+        # Native host tier (hasattr-guarded so a stale prebuilt .so still
+        # serves the classic surface — callers probe can_stage_native).
+        if hasattr(lib, "wal_stage_and_sync"):
+            lib.wal_stage_and_sync.restype = ctypes.c_int
+            lib.wal_stage_and_sync.argtypes = (
+                [ctypes.POINTER(ctypes.c_void_p), ctypes.c_uint32,
+                 ctypes.c_uint32]
+                + [ctypes.c_void_p] * 13
+                + [ctypes.c_int, ctypes.POINTER(ctypes.c_double),
+                   ctypes.POINTER(ctypes.c_double)])
+            lib.wal_pack_ae.restype = ctypes.c_int64
+            lib.wal_pack_ae.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p), ctypes.c_uint32,
+                ctypes.c_uint32, ctypes.c_uint64, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
+            lib.wal_buf_free.restype = None
+            lib.wal_buf_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
         _lib = lib
         return lib
 
 
 def native_available() -> bool:
     return _load() is not None
+
+
+def native_host_available() -> bool:
+    """True when the loaded .so exports the native host tier entry points
+    (wal_stage_and_sync / wal_pack_ae)."""
+    lib = _load()
+    return lib is not None and hasattr(lib, "wal_stage_and_sync")
+
+
+def _shard_split(n_shards: int, g_arr, cols):
+    """Stable-sort rows by WAL stripe (``g % S``) into the CSR layout the
+    native tier consumes: (sorted group column, sorted value columns,
+    ``row_off[S+1]``).  The STABLE sort preserves the staging path's
+    per-group ascending contiguous runs within each shard — the property
+    the engine's hinted-emplace hot loop relies on."""
+    import numpy as np
+    stripe = g_arr % np.uint32(n_shards)
+    order = np.argsort(stripe, kind="stable")
+    sorted_stripe = stripe[order]
+    row_off = np.ascontiguousarray(
+        np.searchsorted(sorted_stripe, np.arange(n_shards + 1)), np.uint64)
+    return (np.ascontiguousarray(g_arr[order]),
+            [np.ascontiguousarray(c[order]) for c in cols],
+            row_off)
+
+
+def _native_stage_and_sync(handles, n_shards, engines, workers, sync,
+                           groups, idxs, terms, ptrs, lens,
+                           trunc_g, trunc_from,
+                           floor_g, floor_idx, floor_term):
+    """One ctypes crossing for a whole tick's durable work: entries (by raw
+    payload pointer), truncations and milestones are split per stripe and
+    handed to wal_stage_and_sync, which stages and fsyncs every shard with
+    real OS threads (the GIL is released for the duration of the call).
+    Returns ``(stage_s, fsync_s)`` — max per-worker wall times."""
+    import numpy as np
+    lib = _load()
+    asc = np.ascontiguousarray
+    eg, (ei, et, ep, el), eoff = _shard_split(
+        n_shards, asc(groups, np.uint32),
+        [asc(idxs, np.uint64), asc(terms, np.int64),
+         asc(ptrs, np.uint64), asc(lens, np.uint32)])
+    tg, (tf,), toff = _shard_split(
+        n_shards, asc(trunc_g, np.uint32), [asc(trunc_from, np.uint64)])
+    fg, (fi, ft), foff = _shard_split(
+        n_shards, asc(floor_g, np.uint32),
+        [asc(floor_idx, np.uint64), asc(floor_term, np.int64)])
+    st = ctypes.c_double()
+    fs = ctypes.c_double()
+    ptr = lambda a: a.ctypes.data_as(ctypes.c_void_p)
+    rc = lib.wal_stage_and_sync(
+        handles, n_shards, max(1, int(workers)),
+        ptr(eoff), ptr(eg), ptr(ei), ptr(et), ptr(ep), ptr(el),
+        ptr(toff), ptr(tg), ptr(tf),
+        ptr(foff), ptr(fg), ptr(fi), ptr(ft),
+        1 if sync else 0, ctypes.byref(st), ctypes.byref(fs))
+    if rc != 0:
+        errs = "; ".join(e.error() for e in engines if e.error())
+        raise IOError(f"wal_stage_and_sync failed: {errs or 'unknown'}")
+    return float(st.value), float(fs.value)
+
+
+def _native_pack_ae(handles, n_shards, workers, cols, starts, ns):
+    """Native AppendEntries blob pack: returns ``(ok_mask, blob)`` where
+    ``blob`` is byte-identical to the Python packer's lens-vector +
+    payload concatenation for the kept columns, or ``None`` on failure
+    (caller falls back to the Python pack loop)."""
+    import numpy as np
+    lib = _load()
+    c = np.ascontiguousarray(cols, np.uint32)
+    s = np.ascontiguousarray(starts, np.uint64)
+    n = np.ascontiguousarray(ns, np.uint32)
+    nc = int(len(c))
+    ok = np.ones(nc, np.uint8)
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    ptr = lambda a: a.ctypes.data_as(ctypes.c_void_p)
+    total = lib.wal_pack_ae(handles, n_shards, max(1, int(workers)), nc,
+                            ptr(c), ptr(s), ptr(n), ptr(ok),
+                            ctypes.byref(out))
+    if total < 0:
+        return None
+    try:
+        blob = ctypes.string_at(out, total) if total else b""
+    finally:
+        if out:
+            lib.wal_buf_free(out)
+    return ok.astype(bool), blob
 
 
 class _NativeWal:
@@ -145,6 +253,31 @@ class _NativeWal:
         self._h = self._lib.wal_open(path.encode(), segment_bytes)
         if not self._h:
             raise IOError(f"wal_open failed for {path}")
+        self._handles = (ctypes.c_void_p * 1)(self._h)
+
+    def error(self) -> str:
+        if not self._h:
+            return ""
+        return (self._lib.wal_error(self._h) or b"").decode(
+            "utf-8", "replace")
+
+    @property
+    def can_stage_native(self) -> bool:
+        return native_host_available()
+
+    def stage_and_sync(self, groups, idxs, terms, ptrs, lens,
+                       trunc_g, trunc_from, floor_g, floor_idx, floor_term,
+                       *, workers: int = 1, sync: bool = True):
+        """Single-shard native host tier: see _native_stage_and_sync."""
+        return _native_stage_and_sync(
+            self._handles, 1, [self], workers, sync,
+            groups, idxs, terms, ptrs, lens,
+            trunc_g, trunc_from, floor_g, floor_idx, floor_term)
+
+    def pack_ae(self, cols, starts, ns, *, workers: int = 1):
+        if not self.can_stage_native:
+            return None
+        return _native_pack_ae(self._handles, 1, workers, cols, starts, ns)
 
     def close(self):
         if self._h:
@@ -797,9 +930,40 @@ class ShardedWal:
             max_workers=min(shards, 8),
             thread_name_prefix="wal-fsync") if shards > 1 else None
         self._gc_active = [False] * shards
+        # Raw engine handles for the native host tier (one ctypes call
+        # staging every shard) — only when EVERY shard is native.
+        self._handles = None
+        if all(isinstance(e, _NativeWal) for e in self.engines):
+            self._handles = (ctypes.c_void_p * shards)(
+                *[e._h for e in self.engines])
 
     def _e(self, g):
         return self.engines[g % self.n_shards]
+
+    @property
+    def can_stage_native(self) -> bool:
+        return self._handles is not None and native_host_available()
+
+    def stage_and_sync(self, groups, idxs, terms, ptrs, lens,
+                       trunc_g, trunc_from, floor_g, floor_idx, floor_term,
+                       *, workers: int = 1, sync: bool = True):
+        """Stage a whole tick's entries/truncations/milestones across every
+        shard — and fsync them — in ONE native call with real OS threads
+        (worker k owns shards ``s % W == k``, the striped pool's ownership
+        map, so per-shard record order and segment bytes are identical to
+        the Python paths).  Returns ``(stage_s, fsync_s)``."""
+        return _native_stage_and_sync(
+            self._handles, self.n_shards, self.engines, workers, sync,
+            groups, idxs, terms, ptrs, lens,
+            trunc_g, trunc_from, floor_g, floor_idx, floor_term)
+
+    def pack_ae(self, cols, starts, ns, *, workers: int = 1):
+        """Native AppendEntries payload-blob pack over the shards' own
+        entry indexes; ``None`` when the native tier is unavailable."""
+        if not self.can_stage_native:
+            return None
+        return _native_pack_ae(self._handles, self.n_shards, workers,
+                               cols, starts, ns)
 
     # -- staging (routes to one shard) ---------------------------------
     def append_entry(self, g, idx, term, payload: bytes):

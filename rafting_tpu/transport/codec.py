@@ -362,7 +362,8 @@ def pack_kind_section(kind: str, fields: Dict[str, np.ndarray],
                       payload_window_fn: Optional[Callable[[int, int, int],
                                                            list]] = None,
                       payload_runs_fn: Optional[Callable] = None,
-                      cols: Optional[np.ndarray] = None
+                      cols: Optional[np.ndarray] = None,
+                      payload_blob_fn: Optional[Callable] = None
                       ) -> Tuple[bytes, int, np.ndarray]:
     """Pack ONE kind's wire section (the ``<BI>`` kind header + columns +
     field planes [+ ae payload blob]) for the given column ids.
@@ -375,6 +376,14 @@ def pack_kind_section(kind: str, fields: Dict[str, np.ndarray],
     an eager (pre-persist) packer defers them to the host phase, where the
     entries are staged; the serial pack path treats a drop as network loss
     (the engine's resend/timeout recovers).  Other kinds never drop.
+
+    ``payload_blob_fn(cols, starts, ns) -> Optional[(ok_mask, blob)]``:
+    the native host tier's bulk blob builder — when it returns a result,
+    the whole per-column Python resolution loop is skipped and ``blob``
+    (byte-identical layout: kept columns' u32 length words, then their
+    payloads) lands in the section directly; columns with ``ok`` False
+    are dropped/deferred exactly like a Python-path payload miss.  A
+    ``None`` return falls back to the Python loop.
     """
     vfield, dfields = KIND_FIELDS[kind]
     if cols is None:
@@ -392,6 +401,22 @@ def pack_kind_section(kind: str, fields: Dict[str, np.ndarray],
         # per-tick critical section of every node).
         prevs = fields["ae_prev_idx"][cols]
         ns = fields["ae_n"][cols]
+        if payload_blob_fn is not None:
+            res = payload_blob_fn(
+                cols, prevs.astype(np.int64) + 1, ns.astype(np.uint32))
+            if res is not None:
+                ok, blob_section = res
+                dropped = cols[~ok]
+                cols = cols[ok]
+                n_cols = len(cols)
+                parts = [struct.pack("<BI", KIND_IDS[kind], n_cols)]
+                if n_cols:
+                    parts.append(cols.tobytes())
+                    for f in dfields:
+                        parts.append(
+                            np.ascontiguousarray(fields[f][cols]).tobytes())
+                    parts.append(blob_section)
+                return b"".join(parts), n_cols, dropped
         keep, drop, pieces, len_parts = [], [], [], []
         for g, prev, n in zip(cols.tolist(), prevs.tolist(), ns.tolist()):
             if n and payload_runs_fn is not None:

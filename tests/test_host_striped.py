@@ -33,6 +33,15 @@ CFG = EngineConfig(n_groups=8, n_peers=3, log_slots=16, batch=4,
                    rpc_timeout_ticks=6, pre_vote=True)
 
 
+@pytest.fixture(autouse=True)
+def _python_host_tier(monkeypatch):
+    """Pin the pure-Python striped tier: with the native .so present the
+    node would auto-route to _host_phase_native and this module's
+    subject (the Python worker pool) would never run.  The native phase
+    has its own suite (test_native_host.py)."""
+    monkeypatch.setenv("RAFT_NATIVE_HOST", "0")
+
+
 @pytest.fixture
 def oracle_checked_step(monkeypatch):
     """Cross-check every runtime node_step call against the scalar oracle

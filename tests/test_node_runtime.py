@@ -194,3 +194,44 @@ def test_submit_batch_fails_wholesale_on_stepdown(cluster):
     # and the cause is the step-down refusal.
     assert err.completed == [False, False]
     assert err.cause is not None
+
+
+def test_empty_apply_skip_counter(tmp_path):
+    """A machine WITHOUT the ``applies_empty`` opt-in (machine/spi.py)
+    has election no-ops short-circuited around it — the dispatcher's
+    ``empty_skips`` tally counts them and the runtime surfaces the sum
+    as the ``empty_apply_skips`` gauge, so a lagging ``last_applied``
+    stays diagnosable after the warn-once log line scrolled away."""
+    from rafting_tpu.testkit.fixtures import NullMachine, NullProvider
+
+    class OptedOutMachine(NullMachine):
+        applies_empty = False
+
+        def apply(self, index, payload):
+            assert payload, "opted-out machine must never see b''"
+            return super().apply(index, payload)
+
+        def apply_batch(self, start_index, payloads):
+            assert all(payloads)
+            return super().apply_batch(start_index, payloads)
+
+    class OptedOutProvider(NullProvider):
+        def bootstrap(self, group):
+            return OptedOutMachine()
+
+    c = LocalCluster(CFG, str(tmp_path), provider_factory=OptedOutProvider)
+    try:
+        lead = c.wait_leader(0)
+        node = c.nodes[lead]
+        fut = node.submit(0, b"after-noop")
+        for _ in range(60):
+            c.tick(1)
+            if fut.done():
+                break
+        assert fut.done()
+        # The elected leader's §8 no-op committed and applied cluster-wide
+        # without the machine seeing it.
+        assert node.dispatcher.empty_skips > 0
+        assert node.metrics._gauges.get("empty_apply_skips", 0) > 0
+    finally:
+        c.close()

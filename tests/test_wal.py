@@ -277,3 +277,53 @@ def test_gc_cross_engine_recovery(tmp_path):
     assert w.stable(2) == (9, 1)
     assert w.entry_payload(2, 35) == b"x" * 64
     w.close()
+
+
+def test_trim_releases_frame_pins(tmp_path):
+    """Run-cache trims must not leave a sliver pinning a frame-sized
+    buffer (ROADMAP carry-forward, log/store.py:55): overwrite, suffix
+    truncation, and floor trims all re-materialize small survivors into
+    compact private buffers, and a fully trimmed run drops its exporter."""
+    from rafting_tpu.transport.codec import PayloadRun
+
+    store = LogStore(str(tmp_path / "wal"))
+    frame = bytearray(1 << 17)   # stands in for a 64MB arena frame
+    frame[:32] = b"abcdefgh" * 4
+    lens = np.array([8, 8, 8, 8], np.uint32)
+
+    def big_span(g, start):
+        return (g, start, memoryview(frame)[:32], lens, 1)
+
+    # Overwrite trim: entries 1..4 pinned to the frame, then an append at
+    # 2 lops the run to one survivor — which must come off the frame.
+    store.append_spans([big_span(0, 1)])
+    _, runs = store._cache[0]
+    assert LogStore._frame_bytes(runs[-1].buf) >= len(frame)
+    store.append_spans([(0, 2, memoryview(b"new-payload-2"),
+                         np.array([13], np.uint32), 2)])
+    _, runs = store._cache[0]
+    assert runs[0].start == 1 and len(runs[0].lens) == 1
+    assert LogStore._frame_bytes(runs[0].buf) < (1 << 16)
+    assert store.payload(0, 1) == b"abcdefgh"
+    assert store.payload(0, 2) == b"new-payload-2"
+
+    # Suffix truncation trim.
+    store.append_spans([big_span(1, 1)])
+    store.truncate_to(1, 2)
+    _, runs = store._cache[1]
+    assert LogStore._frame_bytes(runs[-1].buf) < (1 << 16)
+    assert store.payload(1, 2) == b"abcdefgh"[::1]
+
+    # Floor trim.
+    store.append_spans([big_span(2, 1)])
+    store.set_floor(2, 3, 1)
+    starts, runs = store._cache[2]
+    assert starts[0] == 4 and LogStore._frame_bytes(runs[0].buf) < (1 << 16)
+    assert store.payload(2, 4) == b"abcdefgh"
+
+    # A fully trimmed run must not keep its exporter alive.
+    empty = LogStore._maybe_compact(
+        PayloadRun(5, memoryview(frame), np.zeros(0, np.uint64),
+                   np.zeros(0, np.uint32)))
+    assert empty.buf == b"" and len(empty.lens) == 0
+    store.close()
