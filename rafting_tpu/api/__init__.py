@@ -4,7 +4,8 @@ support/RaftFactory.java / support/RaftConfig.java)."""
 
 from .anomaly import (
     BusyLoopError, NotLeaderError, NotReadyError, ObsoleteContextError,
-    RaftError, RetryCommandError, SerializeError, WaitTimeoutError,
+    RaftError, RetryCommandError, SerializeError, StorageFaultError,
+    WaitTimeoutError,
 )
 from .config import RaftConfig, load_xml_config
 from .container import ADMIN_GROUP, GroupRegistry, RaftContainer
@@ -18,5 +19,5 @@ __all__ = [
     "CmdSerializer", "JsonSerializer", "RawSerializer",
     "RaftError", "NotLeaderError", "NotReadyError", "BusyLoopError",
     "ObsoleteContextError", "WaitTimeoutError", "RetryCommandError",
-    "SerializeError",
+    "SerializeError", "StorageFaultError",
 ]

@@ -57,6 +57,21 @@ class BusyLoopError(RaftError):
     (reference BusyLoopException, support/EventLoop.java:136-138)."""
 
 
+class StorageFaultError(RaftError):
+    """The node's durable storage failed underneath this group: its WAL
+    stripe is fail-stop quarantined (a failed fsync is never retried on
+    the same fd — the page cache may have dropped the dirty pages, so a
+    later "clean" fsync would be a lie).  The lane goes silent and a
+    healthy replica takes over at the next election timeout.
+
+    Marking: FRESH submissions refused with this error are marked
+    retry-safe (they never entered any log); commands already accepted
+    into the log fail with it UNMARKED — their entries may have been
+    replicated before the fault, so the outcome is unknown (the same
+    ambiguity BatchAbortedError documents).  Recovery: retry against the
+    peer that wins the ensuing election."""
+
+
 class ObsoleteContextError(RaftError):
     """The group was closed or destroyed (reference
     ObsoleteContextException; Administrator lifecycle,

@@ -133,7 +133,27 @@ struct Wal {
   std::vector<uint32_t> live_segs; // existing segment ids, ascending
   GcState gc;
   std::string err;
+  // Injectable fault table (testkit/faultfs): countdowns fire once then
+  // disarm (-1).  `poisoned` latches for the handle lifetime — a failed
+  // fsync is never retried on the same fd (fail-stop contract, PARITY.md).
+  int64_t fault_fsync_after = -1;
+  int64_t fault_fsync_errno = EIO;
+  int64_t fault_write_after = -1;
+  int64_t fault_write_errno = EIO;
+  int64_t fault_short_after = -1;
+  int64_t fault_short_keep = 0;
+  int64_t sync_delay_us = 0;
+  bool poisoned = false;
+  int last_errno = 0;
 };
+
+// Countdown semantics: after=N arms the fault for the (N+1)-th guarded call.
+bool fault_fire(int64_t& after) {
+  if (after < 0) return false;
+  if (after == 0) { after = -1; return true; }
+  after--;
+  return false;
+}
 
 std::string seg_path_in(const std::string& dir, uint32_t id) {
   char name[32];
@@ -288,11 +308,50 @@ bool replay_segment(Wal& w, uint32_t id) {
 }
 
 bool flush_buf(Wal& w) {
+  if (w.poisoned) {
+    if (w.err.empty()) w.err = "wal poisoned";
+    return false;
+  }
   if (w.buf.empty()) return true;
+  if (fault_fire(w.fault_short_after)) {
+    // Injected torn write: a prefix of the staged records lands on disk,
+    // then the device "fails".  Poisons like any non-ENOSPC write error;
+    // recovery's CRC framing truncates the torn tail on reopen.
+    size_t keep = (size_t)std::min<int64_t>(
+        std::max<int64_t>(w.fault_short_keep, 0), (int64_t)w.buf.size());
+    size_t off = 0;
+    while (off < keep) {
+      ssize_t wr = ::write(w.fd, w.buf.data() + off, keep - off);
+      if (wr < 0) break;
+      off += (size_t)wr;
+    }
+    w.err = "injected short write";
+    w.last_errno = EIO;
+    w.poisoned = true;
+    return false;
+  }
+  int inj = fault_fire(w.fault_write_after) ? (int)w.fault_write_errno : 0;
   size_t off = 0;
   while (off < w.buf.size()) {
-    ssize_t wr = ::write(w.fd, w.buf.data() + off, w.buf.size() - off);
-    if (wr < 0) { w.err = std::strerror(errno); return false; }
+    ssize_t wr =
+        inj ? -1 : ::write(w.fd, w.buf.data() + off, w.buf.size() - off);
+    if (wr < 0) {
+      int e = inj ? inj : errno;
+      w.err = std::strerror(e);
+      w.last_errno = e;
+      if (e == ENOSPC) {
+        // Disk full is the one RETRIABLE write failure: rewind the segment
+        // to the last known-good offset (a partial flush may have landed)
+        // and keep the buffer so a later barrier retries once space frees.
+        // Fresh segments are opened without O_APPEND, so the file offset
+        // must be walked back alongside the truncate.
+        ::ftruncate(w.fd, (off_t)w.seg_off);
+        ::lseek(w.fd, (off_t)w.seg_off, SEEK_SET);
+      } else {
+        w.poisoned = true;
+      }
+      return false;
+    }
     off += (size_t)wr;
   }
   w.seg_off += w.buf.size();
@@ -302,8 +361,14 @@ bool flush_buf(Wal& w) {
 
 void maybe_rotate(Wal& w) {
   if (w.seg_off + w.buf.size() < w.segment_bytes) return;
-  flush_buf(w);
-  ::fsync(w.fd);
+  if (!flush_buf(w)) return;  // surfaces at the sync barrier
+  if (::fsync(w.fd) != 0) {
+    int e = errno;
+    w.err = std::string("fsync: ") + std::strerror(e);
+    w.last_errno = e;
+    w.poisoned = true;  // never retry fsync on a failed fd
+    return;
+  }
   open_segment(w, w.seg_id + 1, true);
 }
 
@@ -535,8 +600,23 @@ void wal_reset(void* h, uint32_t group) {
 // node tick covers every group (group commit).
 int wal_sync(void* h) {
   Wal* w = (Wal*)h;
+  if (w->poisoned) return -1;  // fail-stop: never fsync a failed fd again
+  if (w->sync_delay_us > 0) ::usleep((useconds_t)w->sync_delay_us);
   if (!flush_buf(*w)) return -1;
-  return ::fsync(w->fd) == 0 ? 0 : -1;
+  if (fault_fire(w->fault_fsync_after)) {
+    w->err = "injected fsync failure";
+    w->last_errno = (int)w->fault_fsync_errno;
+    w->poisoned = true;
+    return -1;
+  }
+  if (::fsync(w->fd) != 0) {
+    int e = errno;
+    w->err = std::string("fsync: ") + std::strerror(e);
+    w->last_errno = e;
+    w->poisoned = true;
+    return -1;
+  }
+  return 0;
 }
 
 // -- reads ------------------------------------------------------------------
@@ -953,6 +1033,47 @@ void wal_gc_abort(void* h) {
 
 const char* wal_error(void* h) { return ((Wal*)h)->err.c_str(); }
 
+// -- injectable fault table (testkit/faultfs) -------------------------------
+// op: 1=fsync-fail 2=write-fail 3=short-write 4=sync-delay.  `after` counts
+// guarded calls before firing (0 = next call); `value` is an errno for ops
+// 1/2 (0 -> EIO), bytes kept for op 3, microseconds for op 4 (op 4 is a
+// level, not a countdown).  Clearing disarms countdowns but does NOT heal
+// `poisoned`: fail-stop latches for the handle lifetime.
+
+int wal_fault_set(void* h, int op, int64_t after, int64_t value) {
+  Wal* w = (Wal*)h;
+  switch (op) {
+    case 1:
+      w->fault_fsync_after = after;
+      w->fault_fsync_errno = value ? value : EIO;
+      return 0;
+    case 2:
+      w->fault_write_after = after;
+      w->fault_write_errno = value ? value : EIO;
+      return 0;
+    case 3:
+      w->fault_short_after = after;
+      w->fault_short_keep = value;
+      return 0;
+    case 4:
+      w->sync_delay_us = value;
+      return 0;
+  }
+  return -1;
+}
+
+void wal_fault_clear(void* h) {
+  Wal* w = (Wal*)h;
+  w->fault_fsync_after = -1;
+  w->fault_write_after = -1;
+  w->fault_short_after = -1;
+  w->sync_delay_us = 0;
+}
+
+int wal_poisoned(void* h) { return ((Wal*)h)->poisoned ? 1 : 0; }
+
+int wal_last_errno(void* h) { return ((Wal*)h)->last_errno; }
+
 // ---------------------------------------------------------------------------
 // Native host tier: the per-stripe persist hot loop behind ONE ctypes call.
 //
@@ -1015,14 +1136,11 @@ int wal_stage_and_sync(void** handles, uint32_t n_shards, uint32_t n_workers,
     const double t1 = mono_s();
     st[k] = t1 - t0;
     if (do_sync) {
-      for (uint32_t s = k; s < n_shards; s += n_workers) {
-        Wal& w = *(Wal*)handles[s];
-        if (!flush_buf(w)) { rc[k] = -1; continue; }
-        if (::fsync(w.fd) != 0) {
-          w.err = std::string("fsync: ") + std::strerror(errno);
-          rc[k] = -1;
-        }
-      }
+      // One wal_sync per shard centralizes the failure policy: poisoned
+      // engines fail fast, injected faults fire, and any fsync failure
+      // latches `poisoned` exactly like the serial barrier.
+      for (uint32_t s = k; s < n_shards; s += n_workers)
+        if (wal_sync(handles[s]) != 0) rc[k] = -1;
       fs[k] = mono_s() - t1;
     }
   };

@@ -483,6 +483,27 @@ class LogStore:
         orchestrator's share of the durability barrier)."""
         self.conf.flush()
 
+    # -- injectable fault table (testkit/faultfs) ----------------------
+    def set_fault(self, op: str, after: int = 0, value: int = 0,
+                  shard: int = 0) -> None:
+        """Arm an injected I/O fault on one WAL stripe (unsharded WALs
+        have exactly stripe 0) — see log/wal.py _FAULT_OPS."""
+        if hasattr(self.wal, "n_shards"):
+            self.wal.set_fault(op, after, value, shard=shard)
+        else:
+            assert shard == 0
+            self.wal.set_fault(op, after, value)
+
+    def clear_faults(self) -> None:
+        self.wal.clear_faults()
+
+    def poisoned_stripes(self):
+        """Stripe ids whose engines latched a fail-stop fault."""
+        ps = getattr(self.wal, "poisoned_shards", None)
+        if ps is not None:
+            return ps()
+        return [0] if getattr(self.wal, "poisoned", False) else []
+
     def checkpoint(self) -> None:
         """Rewrite live state, dropping dead segments (synchronous GC —
         test/offline use; the runtime uses the three-phase path below)."""

@@ -32,12 +32,81 @@ value, so recovery can never silently read a half-striped directory.
 from __future__ import annotations
 
 import ctypes
+import errno as _errno
 import os
 import struct
 import subprocess
 import threading
+import time
 import zlib
 from typing import Dict, Optional
+
+from ..utils import iofault
+
+
+class WalSyncError(IOError):
+    """The durability barrier failed in a NON-RETRIABLE way: fsync error,
+    torn/short write, or any write failure other than disk-full.
+
+    ``shards`` carries the poisoned engine ids — those engines are
+    fail-stop: a failed fsync is never retried on the same fd (the page
+    cache may have dropped the dirty pages that failed to reach the
+    device, so a later "successful" fsync would be a lie — the
+    PostgreSQL fsyncgate lesson).  An EMPTY ``shards`` means a global
+    transient (e.g. the ConfMeta sidecar flush) with no engine poisoned:
+    the caller may skip the tick and retry at the next barrier.
+    ``nospace`` lists any shards that simultaneously hit ENOSPC in the
+    same barrier (mixed-failure merge)."""
+
+    def __init__(self, msg: str, shards=(), nospace=()):
+        super().__init__(msg)
+        self.shards = tuple(shards)
+        self.nospace = tuple(nospace)
+
+
+class WalNoSpace(IOError):
+    """The barrier failed with ENOSPC on ``shards`` — RETRIABLE: each
+    engine rewound its segment to the last good offset and KEPT its
+    staged buffer, so a later barrier retries the flush once space
+    frees.  Callers respond with admission backpressure, not
+    quarantine."""
+
+    def __init__(self, msg: str, shards=()):
+        super().__init__(msg)
+        self.shards = tuple(shards)
+
+
+# Uniform injectable-fault vocabulary across both engines (native op codes).
+# "fsync"/"write" fail the guarded call with `value` as errno (0 -> EIO);
+# "short" persists only `value` bytes of the staged buffer then poisons;
+# "delay" sleeps `value` microseconds at each sync barrier (a level, not a
+# countdown — clear by setting 0).
+_FAULT_OPS = {"fsync": 1, "write": 2, "short": 3, "delay": 4}
+
+
+def _merge_wal_errors(excs):
+    """Collapse per-shard barrier failures into ONE taxonomy exception:
+    non-taxonomy errors win verbatim; otherwise poisoned shards and
+    ENOSPC shards are unioned, with WalSyncError taking precedence (a
+    barrier that poisoned anything is non-retriable as a whole)."""
+    excs = [e for e in excs if e is not None]
+    if not excs:
+        return None
+    for e in excs:
+        if not isinstance(e, (WalSyncError, WalNoSpace)):
+            return e
+    poisoned, nospace = [], []
+    for e in excs:
+        if isinstance(e, WalSyncError):
+            poisoned.extend(e.shards)
+            nospace.extend(e.nospace)
+        else:
+            nospace.extend(e.shards)
+    msg = "; ".join(str(e) for e in excs[:4])
+    if poisoned:
+        return WalSyncError(msg, sorted(set(poisoned)), sorted(set(nospace)))
+    return WalNoSpace(msg, sorted(set(nospace)))
+
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
 _SRC = os.path.join(_NATIVE_DIR, "wal.cpp")
@@ -151,6 +220,18 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
             lib.wal_buf_free.restype = None
             lib.wal_buf_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        # Injectable fault table (hasattr-guarded like the host tier: a
+        # stale prebuilt .so still serves the classic surface).
+        if hasattr(lib, "wal_fault_set"):
+            lib.wal_fault_set.restype = ctypes.c_int
+            lib.wal_fault_set.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_int64, ctypes.c_int64]
+            lib.wal_fault_clear.restype = None
+            lib.wal_fault_clear.argtypes = [ctypes.c_void_p]
+            lib.wal_poisoned.restype = ctypes.c_int
+            lib.wal_poisoned.argtypes = [ctypes.c_void_p]
+            lib.wal_last_errno.restype = ctypes.c_int
+            lib.wal_last_errno.argtypes = [ctypes.c_void_p]
         _lib = lib
         return lib
 
@@ -214,8 +295,16 @@ def _native_stage_and_sync(handles, n_shards, engines, workers, sync,
         ptr(foff), ptr(fg), ptr(fi), ptr(ft),
         1 if sync else 0, ctypes.byref(st), ctypes.byref(fs))
     if rc != 0:
-        errs = "; ".join(e.error() for e in engines if e.error())
-        raise IOError(f"wal_stage_and_sync failed: {errs or 'unknown'}")
+        msg = "; ".join(e.error() for e in engines if e.error()) or "unknown"
+        bad = [getattr(e, "shard_id", k) for k, e in enumerate(engines)
+               if e.poisoned]
+        nosp = [getattr(e, "shard_id", k) for k, e in enumerate(engines)
+                if not e.poisoned and e.last_errno == _errno.ENOSPC]
+        if bad:
+            raise WalSyncError(f"wal_stage_and_sync: {msg}", bad, nosp)
+        if nosp:
+            raise WalNoSpace(f"wal_stage_and_sync: {msg}", nosp)
+        raise WalSyncError(f"wal_stage_and_sync: {msg}", ())
     return float(st.value), float(fs.value)
 
 
@@ -247,6 +336,8 @@ def _native_pack_ae(handles, n_shards, workers, cols, starts, ns):
 
 
 class _NativeWal:
+    shard_id = 0  # ShardedWal pins the true stripe id per engine
+
     def __init__(self, path: str, segment_bytes: int):
         self._lib = _load()
         assert self._lib is not None
@@ -260,6 +351,37 @@ class _NativeWal:
             return ""
         return (self._lib.wal_error(self._h) or b"").decode(
             "utf-8", "replace")
+
+    # -- injectable fault table (testkit/faultfs) ----------------------
+    def set_fault(self, op: str, after: int = 0, value: int = 0) -> None:
+        if not hasattr(self._lib, "wal_fault_set"):
+            raise RuntimeError("native fault table unavailable (stale .so)")
+        if value == 0 and op in ("fsync", "write"):
+            value = _errno.EIO
+        self._lib.wal_fault_set(self._h, _FAULT_OPS[op], int(after),
+                                int(value))
+
+    def clear_faults(self) -> None:
+        if self._h and hasattr(self._lib, "wal_fault_clear"):
+            self._lib.wal_fault_clear(self._h)
+
+    @property
+    def poisoned(self) -> bool:
+        if not self._h or not hasattr(self._lib, "wal_poisoned"):
+            return False
+        return bool(self._lib.wal_poisoned(self._h))
+
+    @property
+    def last_errno(self) -> int:
+        if not self._h or not hasattr(self._lib, "wal_last_errno"):
+            return 0
+        return int(self._lib.wal_last_errno(self._h))
+
+    def _raise_sync_error(self):
+        msg = self.error() or "wal_sync failed"
+        if self.last_errno == _errno.ENOSPC and not self.poisoned:
+            raise WalNoSpace(msg, (self.shard_id,))
+        raise WalSyncError(msg, (self.shard_id,))
 
     @property
     def can_stage_native(self) -> bool:
@@ -302,7 +424,7 @@ class _NativeWal:
 
     def sync(self):
         if self._lib.wal_sync(self._h) != 0:
-            raise IOError("wal_sync failed")
+            self._raise_sync_error()
 
     def tail(self, g):
         return self._lib.wal_tail(self._h, g)
@@ -540,6 +662,15 @@ class PyWal:
         self._f = open(self._seg_path(self._sid), "ab")
         self._buf = bytearray()
         self._gc = None  # {"frozen": [ids], "rewritten": bool}
+        # Failure latches + injectable fault table, mirroring the native
+        # engine: staging never raises — errors latch here and surface at
+        # the sync barrier; `poisoned` is fail-stop for the engine's life.
+        self.shard_id = 0
+        self.poisoned = False
+        self.last_errno = 0
+        self._err = ""
+        self._faults: Dict[str, list] = {}  # op -> [after, value]
+        self._sync_delay_us = 0
 
     def _seg_path(self, sid):
         return os.path.join(self.dir, f"{sid:08d}.wal")
@@ -550,21 +681,92 @@ class PyWal:
     def _replay(self, sid):
         _replay_file(self._seg_path(sid), self.groups)
 
+    def error(self) -> str:
+        return self._err
+
+    def set_fault(self, op: str, after: int = 0, value: int = 0) -> None:
+        """Arm an injected fault: same op vocabulary and countdown
+        semantics as the native engine's wal_fault_set (after=N fires on
+        the (N+1)-th guarded call, then disarms)."""
+        assert op in _FAULT_OPS
+        if op == "delay":
+            self._sync_delay_us = int(value)
+            return
+        if value == 0 and op in ("fsync", "write"):
+            value = _errno.EIO
+        self._faults[op] = [int(after), int(value)]
+
+    def clear_faults(self) -> None:
+        """Disarm pending countdowns; does NOT heal `poisoned`."""
+        self._faults.clear()
+        self._sync_delay_us = 0
+
+    def _fault_fire(self, op: str):
+        f = self._faults.get(op)
+        if f is None:
+            return None
+        if f[0] == 0:
+            del self._faults[op]
+            return f[1]
+        f[0] -= 1
+        return None
+
     def _emit(self, body: bytes):
         self._buf += struct.pack("<III", _MAGIC, len(body), zlib.crc32(body))
         self._buf += body
         if self._f.tell() + len(self._buf) >= self.segment_bytes:
-            self._flush()
-            os.fsync(self._f.fileno())
+            if not self._flush():
+                return  # failure surfaces at the sync barrier
+            try:
+                os.fsync(self._f.fileno())
+            except OSError as e:
+                self._latch(e)
+                self.poisoned = True  # never retry fsync on a failed fd
+                return
             self._f.close()
             self._sid += 1
             self._segs.append(self._sid)
             self._f = open(self._seg_path(self._sid), "wb")
 
-    def _flush(self):
-        if self._buf:
+    def _latch(self, e: OSError) -> None:
+        self._err = str(e)
+        self.last_errno = e.errno or _errno.EIO
+
+    def _flush(self) -> bool:
+        """Write the staged buffer; never raises — failures latch and
+        surface at the barrier.  ENOSPC rewinds the segment to the last
+        good offset and KEEPS the buffer (retriable); any other failure
+        poisons the engine."""
+        if self.poisoned:
+            return False
+        if not self._buf:
+            return True
+        good = self._f.tell()
+        try:
+            keep = self._fault_fire("short")
+            if keep is not None:
+                keep = max(0, min(int(keep), len(self._buf)))
+                self._f.write(self._buf[:keep])
+                self._f.flush()
+                raise iofault.TornWrite(keep)
+            inj = self._fault_fire("write")
+            if inj is not None:
+                raise OSError(int(inj), os.strerror(int(inj)))
             self._f.write(self._buf)
-            self._buf = bytearray()
+            self._f.flush()
+        except OSError as e:
+            self._latch(e)
+            if self.last_errno == _errno.ENOSPC:
+                try:
+                    self._f.seek(good)
+                    self._f.truncate(good)
+                except OSError:
+                    self.poisoned = True
+            else:
+                self.poisoned = True
+            return False
+        self._buf = bytearray()
+        return True
 
     # -- same surface as _NativeWal ------------------------------------
     def append_entry(self, g, idx, term, payload: bytes):
@@ -596,10 +798,29 @@ class PyWal:
         self.groups.pop(g, None)
         self._emit(struct.pack("<BI", _RESET, g))
 
+    def _raise_sync_error(self):
+        msg = self._err or "wal_sync failed"
+        if self.last_errno == _errno.ENOSPC and not self.poisoned:
+            raise WalNoSpace(msg, (self.shard_id,))
+        raise WalSyncError(msg, (self.shard_id,))
+
     def sync(self):
-        self._flush()
-        self._f.flush()
-        os.fsync(self._f.fileno())
+        if self.poisoned:
+            self._raise_sync_error()
+        if self._sync_delay_us > 0:
+            time.sleep(self._sync_delay_us / 1e6)
+        if not self._flush():
+            self._raise_sync_error()
+        try:
+            inj = self._fault_fire("fsync")
+            if inj is not None:
+                raise OSError(int(inj), "injected fsync failure")
+            self._f.flush()
+            os.fsync(self._f.fileno())
+        except OSError as e:
+            self._latch(e)
+            self.poisoned = True
+            self._raise_sync_error()
 
     def tail(self, g):
         return self.groups[g].tail if g in self.groups else 0
@@ -633,8 +854,14 @@ class PyWal:
     def gc_begin(self) -> int:
         if self._gc is not None:
             return -1
-        self._flush()
-        os.fsync(self._f.fileno())
+        if not self._flush():
+            return -1  # latched failure surfaces at the sync barrier
+        try:
+            os.fsync(self._f.fileno())
+        except OSError as e:
+            self._latch(e)
+            self.poisoned = True
+            return -1
         self._f.close()
         frozen = list(self._segs)
         self._sid += 1
@@ -696,7 +923,8 @@ class PyWal:
     def checkpoint(self):
         if self._gc is not None:
             raise IOError("checkpoint refused: three-phase GC pending")
-        self._flush()
+        if not self._flush():
+            self._raise_sync_error()
         os.fsync(self._f.fileno())
         self._f.close()
         old = list(self._segs)
@@ -770,8 +998,11 @@ class PyWal:
         return live
 
     def close(self):
-        self._flush()
-        os.fsync(self._f.fileno())
+        try:
+            self._flush()
+            os.fsync(self._f.fileno())
+        except OSError:
+            pass  # closing a poisoned/failing engine must not raise
         self._f.close()
 
 
@@ -880,11 +1111,18 @@ class ConfMeta:
                                                in ent["entries"].items()}}
                           for g, ent in self._g.items()}}
         tmp = self.path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(doc, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.path)
+        try:
+            iofault.check("conf.flush", self.path)
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+        except OSError as e:
+            # Global transient, nothing poisoned: the dirty flag stays
+            # set, so the next barrier retries the whole flush (tmp file
+            # writes are idempotent).
+            raise WalSyncError(f"conf flush: {e}", ()) from e
         # The rename itself must be durable before the caller's barrier
         # completes (same rule as the WAL GC swap): fsync the directory.
         try:
@@ -923,9 +1161,11 @@ class ShardedWal:
         for k in range(shards):
             sub = os.path.join(path, f"shard{k:02d}")
             if not force_python and native_available():
-                self.engines.append(_NativeWal(sub, segment_bytes))
+                eng = _NativeWal(sub, segment_bytes)
             else:
-                self.engines.append(PyWal(sub, segment_bytes))
+                eng = PyWal(sub, segment_bytes)
+            eng.shard_id = k  # barrier failures carry true stripe ids
+            self.engines.append(eng)
         self._pool = ThreadPoolExecutor(
             max_workers=min(shards, 8),
             thread_name_prefix="wal-fsync") if shards > 1 else None
@@ -1020,12 +1260,13 @@ class ShardedWal:
             self.engines[0].sync()
             return
         futs = [self._pool.submit(e.sync) for e in self.engines]
-        err = None
+        errs = []
         for f in futs:
             try:
                 f.result()
             except Exception as e:  # join ALL before raising
-                err = err or e
+                errs.append(e)
+        err = _merge_wal_errors(errs)
         if err is not None:
             raise err
 
@@ -1033,10 +1274,31 @@ class ShardedWal:
         """Fsync only the given shard engines, inline on the calling
         thread — the striped host tier's durability barrier: each worker
         owns a disjoint set of shards end-to-end (staging AND fsync), so
-        no cross-thread coordination or pool handoff is needed.  Raises
-        on the first failure (the caller must not acknowledge the tick)."""
+        no cross-thread coordination or pool handoff is needed.  Syncs
+        EVERY requested shard before raising the merged failure (the
+        caller must not acknowledge the tick, but healthy shards still
+        become durable)."""
+        errs = []
         for k in shard_ids:
-            self.engines[k].sync()
+            try:
+                self.engines[k].sync()
+            except Exception as e:
+                errs.append(e)
+        err = _merge_wal_errors(errs)
+        if err is not None:
+            raise err
+
+    # -- injectable fault table (testkit/faultfs) ----------------------
+    def set_fault(self, op: str, after: int = 0, value: int = 0,
+                  shard: int = 0) -> None:
+        self.engines[shard % self.n_shards].set_fault(op, after, value)
+
+    def clear_faults(self) -> None:
+        for e in self.engines:
+            e.clear_faults()
+
+    def poisoned_shards(self):
+        return [k for k, e in enumerate(self.engines) if e.poisoned]
 
     # -- per-group reads -----------------------------------------------
     def tail(self, g):

@@ -61,8 +61,9 @@ from ..transport.codec import (
 )
 from ..api.anomaly import (
     BatchAbortedError, BusyLoopError, NotLeaderError, NotReadyError,
-    ObsoleteContextError, as_refusal,
+    ObsoleteContextError, StorageFaultError, as_refusal,
 )
+from ..log.wal import WalNoSpace, WalSyncError
 from ..utils.metrics import Metrics
 from ..utils.profiling import TickProfiler
 from ..utils.tracelog import TraceLog
@@ -318,8 +319,12 @@ class RaftNode:
         self.host_workers = max(1, int(host_workers))
         self._w_eff = min(self.host_workers, n_stripes) if can_stripe else 1
         G0 = cfg.n_groups
+        # group -> WAL stripe (the store's g % S map), shared by the
+        # striped host tier and the storage-fault quarantine plane.
+        self._stripe_of = np.arange(G0, dtype=np.int64) % n_stripes
+        self._n_stripes = n_stripes
         if self._w_eff > 1:
-            stripe_of = np.arange(G0, dtype=np.int64) % n_stripes
+            stripe_of = self._stripe_of
             worker_of = stripe_of % self._w_eff
             self._worker_masks = [worker_of == k for k in range(self._w_eff)]
             self._worker_groups = [np.nonzero(m)[0] for m in self._worker_masks]
@@ -567,6 +572,43 @@ class RaftNode:
                    "leadership_transfers_aborted",
                    "timeout_now_sent"):
             self.metrics[_c] += 0
+        # Storage-fault plane (see _storage_fault): failure-response
+        # policy state + its counters, rendered at 0 from boot.
+        #   - fsync failure     -> fail-stop stripe quarantine (never
+        #     retry fsync on the failed fd — fsyncgate), lanes go silent;
+        #   - ENOSPC            -> admission backpressure, barrier retried
+        #     (engines kept their staged buffers);
+        #   - slow fsync        -> gray-failure watchdog gauge;
+        #   - conf-flush error  -> transient, retried next barrier.
+        self._poisoned_stripes: set = set()
+        self._healthy_groups: Optional[np.ndarray] = None  # None = all
+        # Device-feed clamp: the per-group tail actually CONFIRMED by a
+        # barrier.  None = every staged record is synced and the staged
+        # mirror (_durable_tail_m) is the truth (the zero-copy fast
+        # path); materialized only while a barrier failure leaves staged-
+        # but-unsynced records, so the scan can never self-ack them.
+        self._acked_tail: Optional[np.ndarray] = None
+        self._sync_pending = False     # kept buffers / dirty conf to flush
+        self._io_backpressure = False  # ENOSPC: refuse new submissions
+        self._io_slow = False
+        self._slow_io_s = float(os.environ.get("RAFT_SLOW_IO_S", "0.5"))
+        # Background snapshot scrubber (archive.scrub): a budgeted pass
+        # every interval, a few groups per pass, round-robin cursor.
+        self.scrub_interval_ticks = int(
+            os.environ.get("RAFT_SCRUB_TICKS", "512"))
+        self.scrub_groups_per_pass = 4
+        self._scrub_cursor = 0
+        for _c in ("fsync_failures", "enospc_backpressure",
+                   "storage_transient_errors", "slow_io_ticks",
+                   "ckpt_failures", "scrub_ok", "scrub_corrupt",
+                   "reconnects_total"):
+            self.metrics[_c] += 0
+        self.metrics.gauge("stripes_poisoned", 0)
+        self.metrics.gauge("io_backpressure", 0)
+        self.metrics.gauge("io_slow", 0)
+        # The transport reports its own health (reconnects_total) into
+        # the node registry; set before start() spawns sender threads.
+        self.transport.metrics = self.metrics
         # Flight-recorder drain (cfg.trace_depth > 0): per-group decoded
         # timelines + labeled metrics (elections by cause, leader churn)
         # harvested from the device event rings each tick.  Inert when
@@ -792,6 +834,7 @@ class RaftNode:
         # one-tick race as submit/_refusal — see submit's docstring).
         role, ready, active = self.h_role, self.h_ready, self.h_active
         leader, qn = self.h_leader, self._queued_n
+        hg, bp = self._healthy_groups, self._io_backpressure
         cap = self.group_queue_cap - n
         with self._submit_lock:
             headroom = (self.total_queue_cap - self.busy_threshold
@@ -800,6 +843,16 @@ class RaftNode:
                 g = int(g)
                 sink = BatchSubmit(n, eager=False)
                 sinks.append(sink)
+                if hg is not None and not hg[g]:
+                    sink._refuse(as_refusal(StorageFaultError(
+                        f"group {g}: WAL stripe quarantined after a "
+                        f"durability failure")))
+                    continue
+                if bp:
+                    sink._refuse(as_refusal(BusyLoopError(
+                        f"group {g}: storage backpressure (WAL out of "
+                        f"disk space)")))
+                    continue
                 if not active[g]:
                     sink._refuse(as_refusal(
                         ObsoleteContextError(f"group {g} closed")))
@@ -873,6 +926,15 @@ class RaftNode:
         (reference: RaftStub.process checks, command/RaftStub.java:79-91).
         All are marked pre-log refusals: nothing was enqueued, so a retry
         elsewhere can never double-apply (api/anomaly.py as_refusal)."""
+        if self._healthy_groups is not None \
+                and not self._healthy_groups[group]:
+            return as_refusal(StorageFaultError(
+                f"group {group}: WAL stripe quarantined after a "
+                f"durability failure — retry against the new leader"))
+        if self._io_backpressure:
+            return as_refusal(BusyLoopError(
+                f"group {group}: storage backpressure (WAL out of "
+                f"disk space)"))
         if not self.h_active[group]:
             return as_refusal(ObsoleteContextError(f"group {group} closed"))
         if self.h_role[group] != LEADER:
@@ -1121,9 +1183,15 @@ class RaftNode:
         # phase 10), making ack-after-fsync a kernel invariant rather
         # than a host-ordering convention.
         durable = None
-        if self.pipeline:
+        if self.pipeline or self._acked_tail is not None:
+            # Serial mode normally needs no clamp (the barrier strictly
+            # precedes the next dispatch) — but after a FAILED barrier
+            # the staged mirror is ahead of disk, so the confirmed-tail
+            # clamp (_acked_tail) is fed in serial mode too.
+            src = self._durable_tail_m if self._acked_tail is None \
+                else self._acked_tail
             durable = jnp.asarray(np.minimum(
-                self._durable_tail_m, I32_SAFE_MAX).astype(np.int32))
+                src, I32_SAFE_MAX).astype(np.int32))
         host = HostInbox(
             submit_n=jnp.asarray(submit_n),
             snap_done=jnp.asarray(snap_done),
@@ -1274,14 +1342,27 @@ class RaftNode:
 
         With ``host_workers > 1`` the phase fans out across the striped
         worker pool (``_host_phase_striped``); membership-config ticks
-        fall back to the serial path."""
+        fall back to the serial path.
+
+        Storage faults surface here: a failed durability barrier
+        (WalSyncError / WalNoSpace from the store) aborts the rest of
+        the phase — nothing past the barrier (sends, future
+        completions, reads) runs for this tick — and feeds the
+        failure-response policy in ``_storage_fault``.  ``pre_tail``
+        snapshots the durable-tail mirror BEFORE any staging so the
+        policy knows exactly which per-group tails a failed barrier
+        left unconfirmed."""
+        pre_tail = self._durable_tail_m.copy()
         try:
-            if self._native_host:
-                self._host_phase_native(ctx, defer_send)
-            elif self._w_eff > 1:
-                self._host_phase_striped(ctx, defer_send)
-            else:
-                self._host_phase_serial(ctx, defer_send)
+            try:
+                if self._native_host and not self._poisoned_stripes:
+                    self._host_phase_native(ctx, defer_send)
+                elif self._w_eff > 1:
+                    self._host_phase_striped(ctx, defer_send)
+                else:
+                    self._host_phase_serial(ctx, defer_send)
+            except (WalNoSpace, WalSyncError) as e:
+                self._storage_fault(e, pre_tail)
         finally:
             # This tick's offers are settled even on failure: leaking the
             # inflight counts would mask those groups from every future
@@ -1299,13 +1380,20 @@ class RaftNode:
         prep = self._persist_prepare(
             ctx.info, ctx.term, ctx.voted, ctx.leader, ctx.base,
             ctx.base_term, ctx.staged_payloads, ctx.arrays, ctx.submit_n)
+        # NOTE: staging is NOT masked while stripes are quarantined — a
+        # poisoned engine only buffers (its flush/fsync never run again),
+        # and skipping span-build would drop device-accepted sinks before
+        # they register as promises (hung futures).  The carve-out happens
+        # at the barrier (_barrier) and at outbox packing (silence).
         need_sync = self._persist_stage(prep)
         self._sweep_rejections(prep)
         ctx.staged_payloads = ctx.arrays = None   # drop frame pins early
         _t1 = time.perf_counter()
-        if need_sync:
-            self.store.sync()   # THE durability barrier
+        if need_sync or self._sync_pending:
+            self._barrier()     # THE durability barrier
+            self._barrier_ok()
         _t2 = time.perf_counter()
+        self._watch_io(_t2 - _t1)
 
         # -- 5. release outbox (only ever after the barrier) -----------------
         held = self._stash_outbox_sections(ctx.outbox,
@@ -1384,21 +1472,47 @@ class RaftNode:
         pool = self._ensure_host_pool()
         masks, stripes = self._worker_masks, self._worker_stripes
 
+        poisoned = self._poisoned_stripes
+
         def _phase_a(k: int):
             a0 = time.perf_counter()
             staged = self._persist_stage(prep, mask=masks[k])
             a1 = time.perf_counter()
-            if staged:
-                self.store.sync_stripes(stripes[k])
+            if staged or self._sync_pending:
+                mine = [s for s in stripes[k] if s not in poisoned] \
+                    if poisoned else stripes[k]
+                if mine:
+                    self.store.sync_stripes(mine)
             return a1 - a0, time.perf_counter() - a1
 
         futs = [pool.submit(_phase_a, k) for k in range(1, W)]
-        res_a = [_phase_a(0)] + [f.result() for f in futs]
+        res_a: List[Tuple[float, float]] = []
+        errs: List[Exception] = []
+        try:
+            res_a.append(_phase_a(0))
+        except (WalNoSpace, WalSyncError) as e:
+            errs.append(e)
+            res_a.append((0.0, 0.0))
+        for f in futs:
+            try:
+                res_a.append(f.result())
+            except (WalNoSpace, WalSyncError) as e:
+                errs.append(e)
+                res_a.append((0.0, 0.0))
+        if errs:
+            # EVERY worker has finished — no staging races the fault
+            # handler — and sync_shards already fsynced each worker's
+            # healthy shards before raising, so only the failed stripes'
+            # groups are unconfirmed.  Merge and surface.
+            from ..log.wal import _merge_wal_errors
+            raise _merge_wal_errors(errs)
+        self._watch_io(max(r[1] for r in res_a))
         # Orchestrator-only tail of the barrier: the conf sidecar (dirty
         # only when an adoption span truncated recorded conf entries) is
         # one global file and flushes before any ack leaves; refusal
         # sweeps touch the submit lock.
         self.store.conf_flush()
+        self._barrier_ok()
         self._sweep_rejections(prep)
         ctx.staged_payloads = ctx.arrays = None
 
@@ -1472,10 +1586,12 @@ class RaftNode:
             self._host_phase_serial(ctx, defer_send)
             return
         _st_s, fs_s = self._persist_stage_native(prep)
+        self._watch_io(fs_s)
         # Orchestrator tail of the barrier (same as striped): the conf
         # sidecar flushes before any ack leaves; refusal sweeps touch
         # the submit lock.
         self.store.conf_flush()
+        self._barrier_ok()
         self._sweep_rejections(prep)
         # The native call is done — the arena views the spans pinned are
         # no longer referenced from C.
@@ -1521,6 +1637,151 @@ class RaftNode:
         codec's Python per-column loop)."""
         return self.store.pack_ae_blob(cols, starts, ns,
                                        workers=self._w_native)
+
+    # ------------------------------------------------- storage-fault policy
+
+    def _barrier(self) -> None:
+        """THE durability barrier with quarantined stripes carved out:
+        a poisoned stripe is fail-stop — its fsync is NEVER retried on
+        the same fd (the page cache may have dropped the dirty pages
+        that failed to reach the device, so a later "clean" return
+        would be a lie — the PostgreSQL fsyncgate lesson).  The conf
+        sidecar and every healthy stripe still barrier normally."""
+        if not self._poisoned_stripes:
+            self.store.sync()
+            return
+        cf = getattr(self.store, "conf_flush", None)
+        if cf is not None:
+            cf()
+        healthy = [s for s in range(self._n_stripes)
+                   if s not in self._poisoned_stripes]
+        if healthy and hasattr(self.store, "sync_stripes"):
+            self.store.sync_stripes(healthy)
+
+    def _barrier_ok(self) -> None:
+        """A durability barrier completed: everything staged on healthy
+        stripes is now on disk.  Clear the retry/backpressure state and
+        advance the device-feed clamp for healthy groups (quarantined
+        groups stay frozen at their last confirmed tail forever)."""
+        self._sync_pending = False
+        if self._io_backpressure:
+            self._io_backpressure = False
+            self.metrics.gauge("io_backpressure", 0)
+            log.warning("node %d: WAL barrier recovered — admission "
+                        "backpressure released", self.node_id)
+        if self._acked_tail is None:
+            return
+        if self._healthy_groups is None:
+            self._acked_tail = None   # fully clean: back to the fast path
+        else:
+            np.copyto(self._acked_tail, self._durable_tail_m,
+                      where=self._healthy_groups)
+
+    def _watch_io(self, fsync_s: float) -> None:
+        """Slow-I/O watchdog: a barrier that completes but takes longer
+        than RAFT_SLOW_IO_S is a gray failure — surfaced on /metrics
+        (slow_io_ticks, io_slow) and /healthz, never acted on
+        automatically (a slow disk is not a broken disk)."""
+        if fsync_s > self._slow_io_s:
+            self.metrics["slow_io_ticks"] += 1
+            if not self._io_slow:
+                self._io_slow = True
+                self.metrics.gauge("io_slow", 1)
+                log.warning("node %d: slow storage — fsync barrier took "
+                            "%.3fs (threshold %.3fs)", self.node_id,
+                            fsync_s, self._slow_io_s)
+        elif self._io_slow:
+            self._io_slow = False
+            self.metrics.gauge("io_slow", 0)
+
+    def _storage_fault(self, exc: Exception, pre_tail: np.ndarray) -> None:
+        """Failure-response policy for a failed durability barrier —
+        the principled taxonomy the storage nemesis exercises:
+
+        * ``WalNoSpace`` (ENOSPC): RETRIABLE.  Engines rewound their
+          segments and kept their staged buffers; engage admission
+          backpressure (new submissions refuse with BusyLoop) and force
+          the next tick's barrier to retry the flush.  The tick loop
+          never wedges.
+        * ``WalSyncError`` with poisoned shards (fsync failure, torn
+          write): FAIL-STOP for those stripes.  Quarantine their groups
+          — fail in-flight futures, go silent so peers re-elect.
+        * ``WalSyncError`` with no shards (conf-sidecar flush):
+          transient; skip the tick and retry at the next barrier.
+
+        In every case the rest of this tick's host phase was aborted —
+        nothing past the failed barrier (sends, future completions,
+        read serving) ran, preserving ack-after-fsync — and the
+        device-feed clamp ``_acked_tail`` pins the affected groups at
+        ``pre_tail`` so the scan can never self-ack a staged-but-
+        unsynced range into a commit."""
+        G = self.cfg.n_groups
+        poisoned = set(getattr(exc, "shards", ()) or ())
+        nospace = set(getattr(exc, "nospace", ()) or ())
+        if isinstance(exc, WalNoSpace):
+            nospace |= poisoned
+            poisoned = set()
+        if poisoned or nospace:
+            unconfirmed = np.isin(self._stripe_of,
+                                  sorted(poisoned | nospace))
+        else:
+            # Global transient (conf flush precedes the shard fsyncs in
+            # store.sync): conservatively treat every group's staged
+            # records as unconfirmed until the retried barrier lands.
+            unconfirmed = np.ones(G, bool)
+        if self._acked_tail is None:
+            self._acked_tail = self._durable_tail_m.copy()
+        np.copyto(self._acked_tail,
+                  np.minimum(self._acked_tail, pre_tail),
+                  where=unconfirmed)
+        self._sync_pending = True
+        if nospace:
+            if not self._io_backpressure:
+                self._io_backpressure = True
+                self.metrics.gauge("io_backpressure", 1)
+            self.metrics["enospc_backpressure"] += 1
+            log.error("node %d: WAL out of disk space — admission "
+                      "backpressure engaged, barrier will retry: %s",
+                      self.node_id, exc)
+        new = poisoned - self._poisoned_stripes
+        if new:
+            self._quarantine_stripes(new, exc)
+        elif not poisoned and not nospace:
+            self.metrics["storage_transient_errors"] += 1
+            log.error("node %d: durability barrier failed (transient, "
+                      "retried next tick): %s", self.node_id, exc)
+
+    def _quarantine_stripes(self, shards, cause: Exception) -> None:
+        """Fail-stop quarantine: the groups on ``shards`` go SILENT —
+        in-flight futures fail with StorageFaultError, their lanes
+        deactivate (next dispatch), and no frame for them ever leaves
+        again (outbox packing masks them) — so a healthy replica takes
+        over at the peers' next election timeout.  Deliberately NO
+        TimeoutNow/transfer: any further send for these groups could
+        carry a staged-but-unsynced range that followers would ack into
+        a commit this node cannot durably back (see PARITY.md).
+
+        Queued-but-unoffered submissions and reads are failed by the
+        lifecycle sweep when the deactivation applies (a direct reject
+        here could race an already-dispatched tick's accept accounting);
+        new arrivals are refused immediately via ``_refusal``."""
+        self._poisoned_stripes |= set(shards)
+        self.metrics["fsync_failures"] += len(shards)
+        self.metrics.gauge("stripes_poisoned", len(self._poisoned_stripes))
+        self._healthy_groups = ~np.isin(self._stripe_of,
+                                        sorted(self._poisoned_stripes))
+        bad = np.nonzero(~self._healthy_groups & self.h_active)[0]
+        log.error("node %d: WAL stripe(s) %s fail-stop after durability "
+                  "failure (%s) — quarantining %d group(s); lanes go "
+                  "silent, peers re-elect", self.node_id,
+                  sorted(shards), cause, len(bad))
+        for g in bad.tolist():
+            g = int(g)
+            self.dispatcher.abort_promises(g, StorageFaultError(
+                f"group {g}: WAL stripe quarantined after a durability "
+                f"failure ({cause}); outcome unknown — the entry may "
+                f"already be replicated"))
+            self.set_active(g, False)
 
     # ---------------------------------------------------------- persistence
 
@@ -1907,8 +2168,11 @@ class RaftNode:
         self._durable_tail_m[f_gs] = np.maximum(
             self._durable_tail_m[f_gs], f_idx)
         # Truncations alone do NOT request a sync (serial contract), but
-        # they still stage their records.
-        need_sync = sync and bool(any_write or len(f_gs))
+        # they still stage their records.  A pending barrier (ENOSPC
+        # retry: engines kept their staged buffers) forces the fsync
+        # even on a write-free tick, else the buffers never flush.
+        need_sync = sync and bool(any_write or len(f_gs)
+                                  or self._sync_pending)
         if not (spans or len(t_gs) or len(f_gs) or need_sync):
             return 0.0, 0.0
         return self.store.stage_and_sync(
@@ -2399,6 +2663,13 @@ class RaftNode:
         eager pack dropped (payloads not yet staged) are packed here —
         the rest of the AE traffic already left right after fetch."""
         P = self.cfg.n_peers
+        # Quarantine silence: no frame for a poisoned stripe's groups
+        # ever leaves (their staged ranges may not be durable here — a
+        # resent AE could let followers quorum-commit a range this node
+        # cannot back).  Central choke point for every packing site.
+        hm = self._healthy_groups
+        if hm is not None:
+            mask = hm if mask is None else (mask & hm)
         fields_all = {name: np.asarray(getattr(h_out, name))
                       for name in self.template}
         win = self.store.payloads_window
@@ -2445,6 +2716,12 @@ class RaftNode:
         store cache (entries accepted this very tick — they stage in the
         deferred host phase) are recorded in ``ctx.deferred_ae`` and
         packed there instead."""
+        if self._healthy_groups is not None:
+            # Quarantine active: route ALL AE through the deferred host
+            # phase, whose packing masks the poisoned stripes' groups
+            # (eager frames must never carry their un-durable ranges).
+            ctx.deferred_ae = None
+            return
         P = self.cfg.n_peers
         fields_all = {name: np.asarray(getattr(ctx.outbox, name))
                       for name in self.template}
@@ -2493,6 +2770,14 @@ class RaftNode:
             if ok:
                 self.maintain.note_checkpoint(g, now, idx)
                 self.metrics["snapshots_taken"] += 1
+            else:
+                # Archive copy failed (disk error / injected fault): the
+                # previous milestone stands — note_checkpoint was NOT
+                # called, so compaction never advances past a snapshot
+                # that does not exist on disk, the group stays due, and
+                # the save retries on a later maintain pass.  Surfaced,
+                # never wedged.
+                self.metrics["ckpt_failures"] += 1
         need = self.maintain.need_checkpoint(now, applied, h_base)
         due = np.nonzero(need)[0]
         if len(due) > self.max_checkpoints_per_tick:
@@ -2554,6 +2839,30 @@ class RaftNode:
         self._compact_grant = self.maintain.compact_targets(
             now, self.h_commit.astype(np.int64), h_base.astype(np.int64))
         self._maintain_gc(now)
+        if self.scrub_interval_ticks \
+                and now % self.scrub_interval_ticks == 0:
+            self._scrub_archive()
+
+    def _scrub_archive(self) -> None:
+        """Background snapshot scrubber: one budgeted verify pass —
+        a few groups per interval, round-robin, newest snapshots first
+        (archive.scrub) — so a latent bit flip in an archived snapshot
+        is caught and quarantined BEFORE recovery or a lagging follower
+        would read it.  Runs on the tick thread against tiny per-group
+        budgets; the CRC walk is the cost of one extra file read."""
+        gs = self.archive.groups_with_snapshots(self.cfg.n_groups)
+        if not gs:
+            return
+        for _ in range(min(self.scrub_groups_per_pass, len(gs))):
+            g = gs[self._scrub_cursor % len(gs)]
+            self._scrub_cursor += 1
+            try:
+                ok, corrupt = self.archive.scrub(g, limit=2)
+            except OSError:
+                log.exception("snapshot scrub failed g=%d", g)
+                continue
+            self.metrics["scrub_ok"] += ok
+            self.metrics["scrub_corrupt"] += corrupt
 
     def _ensure_ckpt_workers(self) -> None:
         self._ckpt_threads = [t for t in self._ckpt_threads if t.is_alive()]
@@ -2649,6 +2958,13 @@ class RaftNode:
         unbounded by the frame codec's MAX_BODY."""
         snap = self.archive.last_snapshot(group)
         if snap is None or not os.path.exists(snap.path):
+            return None
+        if self.archive.verify_snapshot(snap.path) == "corrupt":
+            # Never propagate a corrupt milestone to a follower; the
+            # scrubber (tick thread) will quarantine it — this callback
+            # runs on a transport thread and only reads.
+            log.error("node %d: refusing to serve corrupt snapshot %s",
+                      self.node_id, snap.path)
             return None
         return snap.index, snap.term, snap.path
 
@@ -2783,7 +3099,15 @@ class RaftNode:
                 self._wal_floor[g] = max(self._wal_floor[g], snap.index)
                 self._durable_tail_m[g] = max(self._durable_tail_m[g],
                                               snap.index)
-                self.store.sync()
+                try:
+                    self._barrier()   # poisoned stripes carved out
+                    self._barrier_ok()
+                except (WalNoSpace, WalSyncError):
+                    # Keep the flush pending; the installed archive file
+                    # itself is already durable, so the retried fetch
+                    # (device re-requests) converges once space frees.
+                    self._sync_pending = True
+                    raise
                 self.maintain.note_checkpoint(g, self.ticks, snap.index)
                 self.metrics["snapshots_installed"] += 1
                 done.append((g, snap.index, snap.term, cw))
@@ -2811,7 +3135,12 @@ class RaftNode:
         the group's directory as a side effect (100k mkdirs for a node
         that never checkpointed)."""
         for g in self.archive.groups_with_snapshots(self.cfg.n_groups):
-            snap = self.archive.last_snapshot(g)
+            # Verify-on-recovery: a corrupt newest milestone is
+            # quarantined and the walk falls back to the previous one —
+            # WAL replay above the older snapshot restores the rest
+            # (the store keeps entries above ITS floor, which only ever
+            # advanced to milestones whose archive copy was durable).
+            snap = self.archive.verified_last_snapshot(g)
             if snap is None:
                 continue
             m = self.dispatcher.machine(g)

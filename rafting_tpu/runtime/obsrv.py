@@ -103,6 +103,16 @@ class ObservabilityServer:
         n = self.node
         led = int((n.h_role == LEADER).sum())
         ready = int(np.asarray(n.h_ready).sum())
+        # Storage vitals (the storage-fault nemesis surface): quarantined
+        # WAL stripes, ENOSPC admission backpressure, and the slow-I/O
+        # gray-failure watchdog.  ``ok`` stays a liveness bit — a node
+        # with one poisoned stripe still serves its healthy groups.
+        storage = {
+            "poisoned_stripes": sorted(getattr(n, "_poisoned_stripes",
+                                               ()) or ()),
+            "backpressure": bool(getattr(n, "_io_backpressure", False)),
+            "io_slow": bool(getattr(n, "_io_slow", False)),
+        }
         return {
             "ok": True,
             "node_id": int(n.node_id),
@@ -110,6 +120,7 @@ class ObservabilityServer:
             "groups_active": int(n.h_active.sum()),
             "groups_led": led,
             "groups_ready": ready,
+            "storage": storage,
             "trace_depth": int(n.cfg.trace_depth),
             "uptime_s": round(time.monotonic() - self._t0, 3),
         }

@@ -12,13 +12,33 @@ tracks at most one in-flight remote download per group
 from __future__ import annotations
 
 import dataclasses
+import json
+import logging
 import os
 import re
 import shutil
 import threading
 from typing import Dict, List, Optional
 
+from ..utils import iofault
+from ..utils.crc32c import crc32c_file
+
+log = logging.getLogger(__name__)
+
 _NAME = re.compile(r"^snapshot_([0-9a-f]{16})_([0-9a-f]{16})$")
+
+# Integrity sidecar: every archived snapshot file gets a small JSON
+# companion at `<path>.crc` recording its CRC-32C and byte length, written
+# atomically AFTER the payload is fsynced.  The payload file itself stays
+# pristine bytes — state machines read checkpoint paths raw, and the
+# remote-install path streams them verbatim — so integrity metadata must
+# live beside the data, not inside it.  The receiving node recomputes the
+# CRC over the bytes it actually landed on disk, making the check
+# end-to-end across the transfer at the storage layer.  Snapshots from
+# before this scheme have no sidecar and verify as "legacy".
+_CRC_SUFFIX = ".crc"
+_CORRUPT_SUFFIX = ".corrupt"  # quarantined files keep their bytes for
+                              # post-mortem; no scan pattern matches them
 
 
 @dataclasses.dataclass(frozen=True)
@@ -137,8 +157,15 @@ class SnapshotArchive:
         # droppings): a tick-thread install and a pool worker's save must
         # never collide on one temp path.
         tmp = f"{dst}.{threading.get_ident()}.tmp"
+        iofault.check("archive.write", dst)
         shutil.copyfile(src_path, tmp)
+        # Durability + integrity before the atomic publish: fsync the
+        # payload, then record its CRC-32C — computed from the bytes ON
+        # DISK, so a copy/transfer corruption is caught here or by the
+        # scrubber, never served onward as good.
+        crc, size = self._fsync_and_crc(tmp)
         os.replace(tmp, dst)
+        self._write_sidecar(dst, crc, size)
         snap = Snapshot(dst, index, term)
         with self._gen_lock:
             m = self._manifest.setdefault(g, [])
@@ -146,11 +173,104 @@ class SnapshotArchive:
                 m.append(snap)
             drop, self._manifest[g] = m[:-self.retain], m[-self.retain:]
         for s in drop:
-            try:
-                os.unlink(s.path)
-            except OSError:
-                pass
+            for p in (s.path, s.path + _CRC_SUFFIX):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
         return snap
+
+    @staticmethod
+    def _fsync_and_crc(path: str):
+        with open(path, "rb") as f:
+            iofault.check("archive.fsync", path)
+            os.fsync(f.fileno())
+        return crc32c_file(path), os.path.getsize(path)
+
+    @staticmethod
+    def _write_sidecar(path: str, crc: int, size: int) -> None:
+        tmp = path + _CRC_SUFFIX + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"algo": "crc32c", "crc": int(crc), "len": int(size)},
+                      f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path + _CRC_SUFFIX)
+
+    # -- integrity: verify / quarantine / scrub ------------------------------
+
+    @staticmethod
+    def verify_snapshot(path: str) -> str:
+        """Check one archived snapshot against its sidecar.  Returns
+        ``"ok"`` (checksum matches), ``"legacy"`` (no sidecar — predates
+        the scheme, accepted), ``"corrupt"`` (length or CRC mismatch, or
+        unreadable payload with a sidecar present), or ``"missing"`` (the
+        payload file is gone)."""
+        if not os.path.exists(path):
+            return "missing"
+        try:
+            with open(path + _CRC_SUFFIX) as f:
+                meta = json.load(f)
+            want_crc = int(meta["crc"])
+            want_len = int(meta["len"])
+        except (OSError, ValueError, KeyError):
+            return "legacy"
+        try:
+            if os.path.getsize(path) != want_len:
+                return "corrupt"
+            return "ok" if crc32c_file(path) == want_crc else "corrupt"
+        except OSError:
+            return "corrupt"
+
+    def quarantine(self, g: int, snap: Snapshot) -> None:
+        """Fail-stop a corrupt archived snapshot: move the bytes aside
+        (kept for post-mortem under ``*.corrupt``) and drop it from the
+        manifest so no reader — recovery, serve, retention — ever hands
+        it out again."""
+        log.error("snapshot archive: quarantining corrupt %s", snap.path)
+        try:
+            os.replace(snap.path, snap.path + _CORRUPT_SUFFIX)
+        except OSError:
+            pass
+        try:
+            os.unlink(snap.path + _CRC_SUFFIX)
+        except OSError:
+            pass
+        with self._gen_lock:
+            m = self._manifest.get(g)
+            if m is not None:
+                self._manifest[g] = [s for s in m if s.path != snap.path]
+
+    def verified_last_snapshot(self, g: int) -> Optional[Snapshot]:
+        """Newest snapshot that passes verification, quarantining any
+        corrupt newer ones on the way down — the verify-on-recovery walk:
+        a corrupt newest milestone falls back to the previous one (WAL
+        replay above it restores the rest)."""
+        while True:
+            snap = self.last_snapshot(g)
+            if snap is None:
+                return None
+            v = self.verify_snapshot(snap.path)
+            if v in ("ok", "legacy"):
+                return snap
+            self.quarantine(g, snap)
+
+    def scrub(self, g: int, limit: int = 0):
+        """Verify up to ``limit`` (0 = all) of a group's archived
+        snapshots, newest first; corrupt ones are quarantined.  Returns
+        ``(ok, corrupt)`` counts — the background scrubber's unit of
+        work."""
+        ok = corrupt = 0
+        for snap in reversed(self.list_snapshots(g)):
+            if limit and ok + corrupt >= limit:
+                break
+            v = self.verify_snapshot(snap.path)
+            if v == "corrupt":
+                self.quarantine(g, snap)
+                corrupt += 1
+            elif v in ("ok", "legacy"):
+                ok += 1
+        return ok, corrupt
 
     def last_snapshot(self, g: int) -> Optional[Snapshot]:
         with self._gen_lock:

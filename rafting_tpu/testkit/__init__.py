@@ -15,3 +15,4 @@ those become first-class, automated components:
 from .oracle import oracle_step  # noqa: F401
 from .invariants import ClusterChecker, cluster_snapshot  # noqa: F401
 from . import nemesis  # noqa: F401
+from . import faultfs  # noqa: F401

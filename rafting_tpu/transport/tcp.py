@@ -24,6 +24,7 @@ from __future__ import annotations
 import logging
 import os
 import queue
+import random
 import socket
 import struct
 import threading
@@ -34,7 +35,8 @@ from . import codec
 
 log = logging.getLogger(__name__)
 
-RECONNECT_DELAY = 1.0   # seconds (reference EventNode.java:93-94)
+RECONNECT_DELAY = 1.0   # base backoff (reference EventNode.java:93-94)
+RECONNECT_MAX = 15.0    # backoff ceiling for a persistently-down peer
 SEND_QUEUE_CAP = 1024
 
 
@@ -42,11 +44,12 @@ class PeerSender:
     """One persistent outbound channel to a peer, with reconnect."""
 
     def __init__(self, my_id: int, peer_id: int, addr: Tuple[str, int],
-                 hello: bytes):
+                 hello: bytes, metrics=None):
         self.my_id = my_id
         self.peer_id = peer_id
         self.addr = addr
         self.hello = hello
+        self.metrics = metrics
         self.q: "queue.Queue[bytes]" = queue.Queue(SEND_QUEUE_CAP)
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -76,7 +79,17 @@ class PeerSender:
         self._stop.set()
         self._thread.join(timeout=5)
 
+    def _backoff(self, attempts: int) -> float:
+        """Jittered exponential backoff: 1s doubling to the 15s cap, with
+        0.5-1.0x jitter so a restarted peer isn't hit by every sender in
+        lockstep (a reconnect stampede is itself a storage-adjacent fault
+        amplifier: N simultaneous hellos against a node mid-recovery)."""
+        base = min(RECONNECT_MAX,
+                   RECONNECT_DELAY * (2.0 ** min(attempts - 1, 6)))
+        return base * (0.5 + 0.5 * random.random())
+
     def _run(self):
+        attempts = 0
         while not self._stop.is_set():
             sock = None
             try:
@@ -84,6 +97,7 @@ class PeerSender:
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 sock.sendall(self.hello)
                 self.connected = True
+                attempts = 0  # established: next drop restarts the ladder
                 while not self._stop.is_set():
                     try:
                         data = self.q.get(timeout=0.5)
@@ -100,7 +114,14 @@ class PeerSender:
                     except OSError:
                         pass
             if not self._stop.is_set():
-                time.sleep(RECONNECT_DELAY)
+                attempts += 1
+                if self.metrics is not None:
+                    try:
+                        self.metrics["reconnects_total"] += 1
+                    except Exception:  # metrics must never kill the sender
+                        pass
+                # stop.wait, not sleep: close() shouldn't stall on backoff
+                self._stop.wait(self._backoff(attempts))
 
 
 class TcpTransport:
@@ -163,7 +184,8 @@ class TcpTransport:
         for pid, addr in self.peers.items():
             if pid == self.node_id:
                 continue
-            s = PeerSender(self.node_id, pid, addr, self._hello)
+            s = PeerSender(self.node_id, pid, addr, self._hello,
+                           metrics=getattr(self, "metrics", None))
             s.start()
             self._senders[pid] = s
 

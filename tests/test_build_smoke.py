@@ -39,7 +39,9 @@ def test_fresh_so_exports_full_surface(fresh_so):
     for sym in ("wal_open", "wal_close", "wal_append_entry",
                 "wal_append_stable", "wal_truncate", "wal_milestone",
                 "wal_sync", "wal_tail", "wal_floor", "wal_error",
-                "wal_stage_and_sync", "wal_pack_ae", "wal_buf_free"):
+                "wal_stage_and_sync", "wal_pack_ae", "wal_buf_free",
+                "wal_fault_set", "wal_fault_clear", "wal_poisoned",
+                "wal_last_errno"):
         assert hasattr(lib, sym), f"missing export: {sym}"
 
 
@@ -74,3 +76,51 @@ def test_binding_reports_native_host():
     agree that the host tier is available when a toolchain exists."""
     assert wal_mod.native_available()
     assert wal_mod.native_host_available()
+
+
+_SAN_FLAGS = ["-fsanitize=address,undefined", "-fno-sanitize-recover=all",
+              "-g", "-O1"]
+
+
+def _have_sanitizers(scratch) -> bool:
+    """Probe: can this toolchain build AND run a sanitized binary?  Some
+    containers ship g++ without libasan/libubsan, or block the ptrace
+    ASan needs — skip rather than fail there."""
+    src = scratch / "probe.cpp"
+    src.write_text("int main() { return 0; }\n")
+    exe = str(scratch / "probe")
+    r = subprocess.run(["g++", *_SAN_FLAGS, str(src), "-o", exe],
+                       capture_output=True, text=True, timeout=120)
+    if r.returncode != 0:
+        return False
+    r = subprocess.run([exe], capture_output=True, timeout=60,
+                       env={**os.environ, "ASAN_OPTIONS": "detect_leaks=0"})
+    return r.returncode == 0
+
+
+def test_native_fault_smoke_under_sanitizers(tmp_path):
+    """Build wal.cpp + the fault-smoke driver under ASan/UBSan and run
+    the injected-fault scenarios (fail-stop fsync, retriable ENOSPC,
+    torn write) as a standalone executable — a sanitized .so cannot be
+    dlopen'd into this unsanitized pytest process, so the smoke runs out
+    of process.  Catches allocator misuse / UB on the exact error paths
+    the storage nemesis exercises."""
+    if not _have_sanitizers(tmp_path):
+        pytest.skip("sanitizer runtime unavailable on this host")
+    driver = os.path.join(os.path.dirname(__file__),
+                          "native_fault_smoke.cpp")
+    exe = str(tmp_path / "fault_smoke")
+    r = subprocess.run(
+        ["g++", *_SAN_FLAGS, "-std=c++17", "-pthread",
+         wal_mod._SRC, driver, "-o", exe],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, \
+        f"sanitized build failed:\n{r.stderr[-2000:]}"
+    scratch = tmp_path / "wal-scratch"
+    scratch.mkdir()
+    r = subprocess.run(
+        [exe, str(scratch)], capture_output=True, text=True, timeout=120,
+        env={**os.environ, "ASAN_OPTIONS": "detect_leaks=0"})
+    assert r.returncode == 0, \
+        f"fault smoke failed (rc={r.returncode}):\n" \
+        f"{r.stdout[-1000:]}\n{r.stderr[-3000:]}"

@@ -1,0 +1,244 @@
+"""Storage-fault nemesis, engine tier: the injectable I/O fault table on
+both WAL engines (native C++ and Python), the seeded fault planner, the
+cold-path iofault hook, and the at-rest corruption utility.
+
+The contract under test is the failure taxonomy in log/wal.py:
+
+* injected fsync failure / torn write  -> fail-stop (WalSyncError with
+  poisoned shard ids; the engine never fsyncs that fd again);
+* injected ENOSPC                      -> retriable (WalNoSpace; segment
+  rewound, staged buffer KEPT, the next barrier lands everything);
+* injected delay                       -> the barrier completes, slowly
+  (the gray-failure regime the node's watchdog surfaces).
+
+Both engines must behave identically — the same plans drive either tier.
+"""
+
+import errno
+import os
+import time
+
+import pytest
+
+from rafting_tpu.log import LogStore, WalStore, native_available
+from rafting_tpu.log.wal import WalNoSpace, WalSyncError
+from rafting_tpu.testkit import faultfs
+from rafting_tpu.utils import iofault
+
+BACKENDS = ["python"] + (["native"] if native_available() else [])
+
+
+@pytest.fixture(params=BACKENDS)
+def backend(request):
+    return request.param
+
+
+def mk(path, backend, shards=1):
+    return WalStore(str(path), segment_bytes=1 << 20,
+                    force_python=(backend == "python"), shards=shards)
+
+
+# ------------------------------------------------------------- planner --
+
+def test_plan_deterministic_and_seed_sensitive():
+    kw = dict(fsync_p=0.05, enospc_p=0.05, short_p=0.03, delay_p=0.03)
+    a = faultfs.plan_storage_faults(128, 4, seed=11, **kw)
+    b = faultfs.plan_storage_faults(128, 4, seed=11, **kw)
+    c = faultfs.plan_storage_faults(128, 4, seed=12, **kw)
+    assert a == b
+    assert a != c
+    assert len(a) > 0
+    for ev in a:
+        assert 0 <= ev.tick < 128 and 0 <= ev.shard < 4
+        assert ev.op in faultfs.ENGINE_OPS
+
+
+def test_plan_max_events_caps():
+    p = faultfs.plan_storage_faults(256, 2, seed=3, fsync_p=0.5,
+                                    max_events=5)
+    assert len(p) == 5
+
+
+def test_injector_arms_on_schedule(tmp_path):
+    store = LogStore(str(tmp_path / "wal"), force_python=True)
+    plan = (faultfs.FaultEvent(3, "fsync", 0, 0, errno.EIO),)
+    inj = faultfs.FaultInjector(store, plan)
+    for t in range(3):
+        assert inj.advance(t) == []
+        store.wal.append_entry(0, t + 1, 1, b"x")
+        store.sync()   # nothing armed yet: barriers succeed
+    assert len(inj.advance(3)) == 1
+    store.wal.append_entry(0, 4, 1, b"x")
+    with pytest.raises(WalSyncError):
+        store.sync()
+    assert store.poisoned_stripes() == [0]
+    assert inj.pending == 0
+
+
+# ------------------------------------------------- engine fault table --
+
+def test_fsync_fault_is_fail_stop(tmp_path, backend):
+    w = mk(tmp_path / "w", backend)
+    w.append_entry(0, 1, 1, b"good")
+    w.sync()
+    w.set_fault("fsync")
+    w.append_entry(0, 2, 1, b"doomed")
+    with pytest.raises(WalSyncError) as ei:
+        w.sync()
+    assert ei.value.shards == (0,)
+    assert w.poisoned
+    # clear_faults disarms countdowns but must NOT heal the poison:
+    # a failed fsync is never retried on the same fd.
+    w.clear_faults()
+    with pytest.raises(WalSyncError):
+        w.sync()
+    w.close()
+    # A fresh handle starts clean and replays the durable prefix.
+    r = mk(tmp_path / "w", backend)
+    assert not r.poisoned
+    assert r.tail(0) >= 1
+
+
+def test_enospc_is_retriable(tmp_path, backend):
+    w = mk(tmp_path / "w", backend)
+    w.set_fault("write", value=errno.ENOSPC)
+    w.append_entry(0, 1, 1, b"kept-through-enospc")
+    with pytest.raises(WalNoSpace) as ei:
+        w.sync()
+    assert ei.value.shards == (0,)
+    assert not w.poisoned
+    # One-shot fault consumed: the engine kept its staged buffer, so the
+    # retried barrier lands the record with no re-staging by the caller.
+    w.sync()
+    w.close()
+    r = mk(tmp_path / "w", backend)
+    assert r.tail(0) == 1
+    assert r.entry_payload(0, 1) == b"kept-through-enospc"
+
+
+def test_short_write_poisons_and_recovery_truncates(tmp_path, backend):
+    w = mk(tmp_path / "w", backend)
+    w.append_entry(0, 1, 1, b"pre")
+    w.sync()
+    w.set_fault("short", value=5)   # 5 bytes of the next flush land
+    w.append_entry(0, 2, 1, b"torn-away")
+    with pytest.raises(WalSyncError):
+        w.sync()
+    assert w.poisoned
+    w.close()
+    # Reopen: CRC framing drops the torn tail; the synced prefix stands.
+    r = mk(tmp_path / "w", backend)
+    assert r.tail(0) == 1
+    assert r.entry_payload(0, 1) == b"pre"
+
+
+def test_delay_fault_slows_the_barrier(tmp_path, backend):
+    w = mk(tmp_path / "w", backend)
+    w.set_fault("delay", value=120_000)   # 120ms per barrier (a level)
+    w.append_entry(0, 1, 1, b"x")
+    t0 = time.perf_counter()
+    w.sync()
+    assert time.perf_counter() - t0 >= 0.1
+    w.clear_faults()
+    t0 = time.perf_counter()
+    w.sync()
+    assert time.perf_counter() - t0 < 0.1
+
+
+def test_sharded_barrier_merges_per_stripe_failures(tmp_path, backend):
+    w = mk(tmp_path / "w", backend, shards=2)
+    # Groups stripe g % 2: group 0 -> shard 0 (healthy), 1 -> shard 1.
+    w.append_entry(0, 1, 1, b"healthy")
+    w.append_entry(1, 1, 1, b"doomed")
+    w.set_fault("fsync", shard=1)
+    with pytest.raises(WalSyncError) as ei:
+        w.sync()
+    # The healthy stripe synced before the merged error was raised.
+    assert ei.value.shards == (1,)
+    assert w.poisoned_shards() == [1]
+    w.close()
+    r = mk(tmp_path / "w", backend, shards=2)
+    assert r.tail(0) == 1
+    r.close()
+
+
+def test_sharded_mixed_enospc_and_poison(tmp_path, backend):
+    w = mk(tmp_path / "w", backend, shards=2)
+    w.append_entry(0, 1, 1, b"a")
+    w.append_entry(1, 1, 1, b"b")
+    w.set_fault("write", value=errno.ENOSPC, shard=0)
+    w.set_fault("fsync", shard=1)
+    with pytest.raises(WalSyncError) as ei:
+        w.sync()
+    # Poison dominates (the barrier is non-retriable as a whole) but the
+    # ENOSPC stripe is still reported for backpressure accounting.
+    assert ei.value.shards == (1,)
+    assert ei.value.nospace == (0,)
+    w.close()
+
+
+# --------------------------------------------------- cold-path faults --
+
+def test_cold_faults_one_shot_and_restore():
+    assert not iofault.installed()
+    with faultfs.ColdFaults() as cf:
+        cf.arm("conf.flush", err=errno.EIO)
+        assert iofault.installed()
+        with pytest.raises(OSError) as ei:
+            iofault.check("conf.flush", "/some/conf")
+        assert ei.value.errno == errno.EIO
+        # one-shot: consumed
+        iofault.check("conf.flush", "/some/conf")
+        assert cf.fired == [("conf.flush", "/some/conf")]
+    assert not iofault.installed()
+
+
+def test_cold_faults_torn_and_after():
+    with faultfs.ColdFaults() as cf:
+        cf.arm("archive.write", torn_keep=7, after=1)
+        iofault.check("archive.write", "p")     # skipped (after=1)
+        with pytest.raises(iofault.TornWrite) as ei:
+            iofault.check("archive.write", "p")
+        assert ei.value.keep == 7
+
+
+def test_cold_faults_break_archive_seal(tmp_path):
+    from rafting_tpu.snapshot.archive import SnapshotArchive
+    a = SnapshotArchive(str(tmp_path / "arch"))
+    src = tmp_path / "ckpt.bin"
+    src.write_bytes(b"machine-state-1")
+    with faultfs.ColdFaults() as cf:
+        cf.arm("archive.fsync", err=errno.EIO)
+        with pytest.raises(OSError):
+            a.save_checkpoint(0, str(src), 5, 1)
+    assert a.last_snapshot(0) is None   # failed seal never published
+    snap = a.save_checkpoint(0, str(src), 5, 1)
+    assert a.verify_snapshot(snap.path) == "ok"
+
+
+# ------------------------------------------------------------ flip_bits --
+
+def test_flip_bits_deterministic(tmp_path):
+    p1, p2 = tmp_path / "a.bin", tmp_path / "b.bin"
+    p1.write_bytes(bytes(range(256)))
+    p2.write_bytes(bytes(range(256)))
+    f1 = faultfs.flip_bits(str(p1), seed=9, n_flips=3)
+    f2 = faultfs.flip_bits(str(p2), seed=9, n_flips=3)
+    assert f1 == f2
+    assert p1.read_bytes() == p2.read_bytes()
+    assert p1.read_bytes() != bytes(range(256))
+
+
+def test_flip_bits_defeats_snapshot_crc(tmp_path):
+    from rafting_tpu.snapshot.archive import SnapshotArchive
+    a = SnapshotArchive(str(tmp_path / "arch"))
+    src = tmp_path / "ckpt.bin"
+    src.write_bytes(b"x" * 1024)
+    snap = a.save_checkpoint(0, str(src), 3, 1)
+    assert a.verify_snapshot(snap.path) == "ok"
+    faultfs.flip_bits(snap.path, seed=1)
+    assert a.verify_snapshot(snap.path) == "corrupt"
+    ok, corrupt = a.scrub(0)
+    assert (ok, corrupt) == (0, 1)
+    assert a.last_snapshot(0) is None
+    assert os.path.exists(snap.path + ".corrupt")

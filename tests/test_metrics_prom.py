@@ -114,15 +114,18 @@ def test_windowed_rates_cover_absolute_set_counters():
     assert not math.isnan(r)
 
 
-def test_host_tier_metrics_on_exposition(tmp_path):
+def test_host_tier_metrics_on_exposition(tmp_path, monkeypatch):
     """ISSUE 10 satellite: the striped host tier's observability — the
     host_workers gauge, the per-worker stripe_busy_s histogram and the
     eager_sends counter (rendered with the _total suffix, zero from boot
     via its counter init) — all appear on /metrics and the page passes
-    the strict validator."""
+    the strict validator.  Pins the Python striped tier: the native
+    phase measures stage/fsync in C and has no per-worker busy samples
+    to report."""
     from rafting_tpu.core.types import EngineConfig
     from rafting_tpu.testkit.harness import LocalCluster
 
+    monkeypatch.setenv("RAFT_NATIVE_HOST", "0")
     cfg = EngineConfig(n_groups=4, n_peers=3, log_slots=16, batch=4,
                        max_submit=4, election_ticks=6, heartbeat_ticks=2,
                        rpc_timeout_ticks=5)

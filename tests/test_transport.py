@@ -256,3 +256,45 @@ def test_tcp_snapshot_larger_than_max_body(tmp_path):
     finally:
         ts[0].close()
         ts[1].close()
+
+
+def test_reconnect_backoff_math():
+    """Jittered exponential ladder: doubles from RECONNECT_DELAY, caps at
+    RECONNECT_MAX, and every draw lands in [0.5, 1.0] x the deterministic
+    base so a restarted peer never sees a sender stampede."""
+    from rafting_tpu.transport.tcp import (
+        PeerSender, RECONNECT_DELAY, RECONNECT_MAX)
+    s = PeerSender(0, 1, ("127.0.0.1", 1), b"hello")
+    for attempts in range(1, 24):
+        base = min(RECONNECT_MAX, RECONNECT_DELAY * 2 ** min(attempts - 1, 6))
+        for _ in range(16):
+            d = s._backoff(attempts)
+            assert 0.5 * base <= d <= base
+    assert s._backoff(20) <= RECONNECT_MAX
+
+
+def test_reconnect_counter_on_dead_peer():
+    """A sender pointed at a dead address increments reconnects_total on
+    every drop, and stop() interrupts the backoff wait promptly."""
+    import socket as _socket
+
+    from rafting_tpu.transport.tcp import PeerSender
+    from rafting_tpu.utils.metrics import Metrics
+
+    # Reserve a port nobody is listening on.
+    probe = _socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+
+    m = Metrics()
+    s = PeerSender(0, 1, ("127.0.0.1", port), b"hello", metrics=m)
+    s.start()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and m["reconnects_total"] < 1:
+        time.sleep(0.02)
+    t0 = time.monotonic()
+    s.stop()
+    assert time.monotonic() - t0 < 5   # stop never waits out the backoff
+    assert m["reconnects_total"] >= 1
+    assert not s.connected
