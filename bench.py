@@ -469,6 +469,64 @@ def run_member_ladder(profile_unused: str = "") -> None:
         sys.exit(1)
 
 
+def run_latency_ab() -> None:
+    """BENCH_LAT=1: the latency-plane overhead A/B replaces the ladder —
+    durable commits/sec through bench_runtime.run() with span sampling
+    ON (1/64, the default rate) vs OFF (RAFT_LAT_SAMPLE=0) at the same
+    scale (default 100k groups, BENCH_LAT_SCALE overrides), in one
+    process so all runs share jit caches and the comparison is
+    load-for-load fair.  Mirrored ABBA order (off, on, on, off): on a
+    shared host, back-to-back in-process runs drift — the second of two
+    IDENTICAL unsampled runs measured ~10% slower on a single-vCPU
+    container — and ABBA cancels linear drift exactly, where a naive
+    off-then-on pair books the entire drift as "sampling overhead".
+    Asserts the sampled pair keeps >98% of the unsampled pair's
+    throughput — the plane's whole admission design (seeded stride
+    selection, bounded in-flight spans, single-writer harvest) exists to
+    make observation cheaper than 2%.  The ON runs' results carry the
+    per-entry e2e + per-phase distributions."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import bench_runtime
+    scale = int(os.environ.get("BENCH_LAT_SCALE", "100000"))
+    off1 = bench_runtime.run(n_groups=scale, lat_sample=0)
+    on1 = bench_runtime.run(n_groups=scale, lat_sample=64)
+    on2 = bench_runtime.run(n_groups=scale, lat_sample=64)
+    off2 = bench_runtime.run(n_groups=scale, lat_sample=0)
+    assert on1["latency"]["sample_rate"] == 64 and \
+        off1["latency"]["sample_rate"] == 0, "A/B pins did not take"
+    on_cps = (on1["value"] + on2["value"]) / 2
+    off_cps = (off1["value"] + off2["value"]) / 2
+    overhead = 1.0 - on_cps / max(off_cps, 1)
+    res = {
+        "scale": scale,
+        "platform": "cpu",
+        "lat_overhead": round(overhead, 4),
+        "sampled_commits_per_sec": round(on_cps),
+        "unsampled_commits_per_sec": round(off_cps),
+        "order": "ABBA (off, on, on, off)",
+        "sampled": [on1, on2],
+        "unsampled": [off1, off2],
+    }
+    save_artifact(res, note="BENCH_LAT stage: span-sampling overhead A/B")
+    emit({
+        "metric": f"latency-plane sampling overhead @{scale // 1000}k "
+                  f"groups (durable runtime, 1/64 sampling vs off, "
+                  f"loopback)",
+        "value": round(overhead * 100, 2),
+        "unit": "% durable commits/sec regression (target <2%)",
+        "vs_baseline": None,
+        "sampled_commits_per_sec": round(on_cps),
+        "unsampled_commits_per_sec": round(off_cps),
+        "sampled_e2e": on1["latency"].get("e2e"),
+        "sampled_counts": on1["latency"].get("counts"),
+    })
+    assert overhead < 0.02, (
+        f"latency plane costs {overhead * 100:.2f}% durable throughput "
+        f"(budget: 2%) — sampled {on_cps:.0f} vs unsampled "
+        f"{off_cps:.0f} commits/sec")
+
+
 def headline(res: dict, fallback: str = "", tuned: bool = False,
              extra_note: str = "") -> dict:
     plat = res["platform"]
@@ -632,6 +690,11 @@ def main() -> None:
         # BENCH_READS run measures reads): reconfig walk-through
         # throughput + the masked-vs-fixed commit kernel A/B.
         run_member_ladder()
+        return
+    if env_flag("BENCH_LAT"):
+        # The latency-plane overhead A/B replaces the ladder: durable
+        # commits/sec with 1/64 span sampling vs off (<2% budget).
+        run_latency_ab()
         return
 
     profile_dir = os.environ.get("BENCH_PROFILE_DIR", "")

@@ -53,7 +53,7 @@ def _shape(n_groups: int):
 
 def run(n_groups: int = 1024, rounds: int = 0, burst_n: int = 0,
         transport: str = "loopback", pipeline=None,
-        host_workers=None, native=None) -> dict:
+        host_workers=None, native=None, lat_sample=None) -> dict:
     """``pipeline``: True/False forces the durable pipeline on/off for
     every node; None uses the runtime default (RAFT_PIPELINE env if set,
     else on only for accelerator engine backends — see RaftNode).
@@ -61,7 +61,11 @@ def run(n_groups: int = 1024, rounds: int = 0, burst_n: int = 0,
     runtime default, env RAFT_HOST_WORKERS else 1 = serial).
     ``native``: True/False pins the C++ stage_and_sync host tier on/off
     via RAFT_NATIVE_HOST for the run; None = runtime auto-selection
-    (native whenever the .so loads)."""
+    (native whenever the .so loads).
+    ``lat_sample``: pins RAFT_LAT_SAMPLE (1/N span sampling; 0 disables
+    the latency plane entirely) for the run; None = env default.  When
+    the plane is on, the result carries per-entry commit-path latency
+    distributions (e2e + per-phase), not just throughput."""
     from rafting_tpu.core.types import EngineConfig, LEADER
     from rafting_tpu.testkit.fixtures import NullProvider
     from rafting_tpu.testkit.harness import LocalCluster
@@ -84,19 +88,23 @@ def run(n_groups: int = 1024, rounds: int = 0, burst_n: int = 0,
         max_submit=int(os.environ.get("BENCH_RT_SUBMIT", "32")),
         election_ticks=10, heartbeat_ticks=3, rpc_timeout_ticks=8)
     root = tempfile.mkdtemp(prefix="bench-runtime-")
-    env_prev = os.environ.get("RAFT_NATIVE_HOST")
+    pins = {}
     if native is not None:
-        os.environ["RAFT_NATIVE_HOST"] = "1" if native else "0"
+        pins["RAFT_NATIVE_HOST"] = "1" if native else "0"
+    if lat_sample is not None:
+        pins["RAFT_LAT_SAMPLE"] = str(lat_sample)
+    env_prev = {k: os.environ.get(k) for k in pins}
+    os.environ.update(pins)
     try:
         c = LocalCluster(cfg, root, provider_factory=NullProvider, seed=0,
                          transport=transport, pipeline=pipeline,
                          host_workers=host_workers)
     finally:
-        if native is not None:
-            if env_prev is None:
-                os.environ.pop("RAFT_NATIVE_HOST", None)
+        for k, v in env_prev.items():
+            if v is None:
+                os.environ.pop(k, None)
             else:
-                os.environ["RAFT_NATIVE_HOST"] = env_prev
+                os.environ[k] = v
     payload = b"x" * 64
     burst = [payload] * burst_n
 
@@ -140,6 +148,11 @@ def run(n_groups: int = 1024, rounds: int = 0, burst_n: int = 0,
             n.metrics.histogram("tick_latency_s").reset()
             for stage in n.metrics.breakdown():
                 n.metrics.histogram(f"tick_stage_{stage}").reset()
+            # Per-entry latency distributions are measure-phase only too
+            # (a warmup span that waited out an election would own p999).
+            for name in list(n.metrics._histograms):
+                if name.startswith("lat_"):
+                    n.metrics.histogram(name).reset()
             # Windowed-rate baseline: rates(since_last=True) below then
             # reports measure-phase throughput, not a lifetime average
             # diluted by election warmup + compile ticks.
@@ -178,6 +191,34 @@ def run(n_groups: int = 1024, rounds: int = 0, burst_n: int = 0,
                    key=lambda n: n.metrics.histogram("tick_latency_s").total)
         stages = {k: round(v["mean"], 6)
                   for k, v in slow.metrics.breakdown().items()}
+        # Per-entry commit-path latency distributions (the sampled span
+        # plane, utils/latency.py) from the node with the most completed
+        # spans — leadership is spread across nodes, so any single node
+        # sees ~1/3 of the sampled population.
+        latency = {"sample_rate": 0}
+        lat_node = max(c.nodes.values(),
+                       key=lambda n: n.metrics.histogram("lat_e2e_s").n)
+        if lat_node._lat is not None:
+            def _summ(name):
+                h = lat_node.metrics._histograms.get(name)
+                if h is None or not h.n:
+                    return None
+                s = h.summary()
+                return {"count": s["count"], "mean_s": round(s["mean"], 6),
+                        "p50_s": round(s["p50"], 6),
+                        "p99_s": round(s["p99"], 6),
+                        "p999_s": round(h.quantile(0.999), 6),
+                        "max_s": round(s["max"], 6)}
+            latency = {
+                "sample_rate": lat_node._lat.rate,
+                "counts": dict(lat_node._lat.counts),
+                "e2e": _summ("lat_e2e_s"),
+                "phases": {name: s for name in (
+                    "submit_offer", "offer_stage", "stage_fsync",
+                    "fsync_send", "send_commit", "commit_apply",
+                    "apply_ack")
+                    if (s := _summ(f"lat_{name}_s")) is not None},
+            }
         return {
             "metric": f"durable-runtime commits/sec @{n_groups} groups "
                       f"(3 nodes, WAL fsync barrier, applies, {transport})",
@@ -196,6 +237,7 @@ def run(n_groups: int = 1024, rounds: int = 0, burst_n: int = 0,
             "tick_latency": lat,
             "tick_stages_mean_s": stages,
             "applies_per_sec_windowed": round(applies_ps),
+            "latency": latency,
         }
     finally:
         c.close()

@@ -65,6 +65,11 @@ class RaftConfig:
     # peer-health metrics (reference RaftConfig.java:137-141)
     avail_critical_point: int = 3
     recovery_cool_down_ticks: int = 10
+    # end-to-end commit-latency SLO target (milliseconds): the latency
+    # plane's burn gauges and /healthz latency block measure against it
+    # (utils/latency.py; beyond-reference — the reference has no latency
+    # instrumentation at all).
+    latency_slo_ms: float = 500.0
     # submission backpressure (reference EventLoop queue capacity + busy
     # threshold, support/EventLoop.java:16-17, 136-138)
     group_queue_cap: int = 512
@@ -85,6 +90,8 @@ class RaftConfig:
                              "(reference RaftConfig.java:116-118)")
         if self.tick_ms <= 0:
             raise ValueError("tick_ms must be positive")
+        if self.latency_slo_ms <= 0:
+            raise ValueError("latency_slo_ms must be positive")
         if self.group_queue_cap < 1:
             raise ValueError("group_queue_cap must be >= 1")
         if self.busy_threshold < 0:
@@ -175,7 +182,8 @@ def load_xml_config(path: str) -> RaftConfig:
           <snapshot state-change-threshold="64" dirty-log-tolerance="16"
                     snap-min-interval="20" compact-min-interval="10"
                     slack="8"/>
-          <metrics avail-critical-point="3" recovery-cool-down="10"/>
+          <metrics avail-critical-point="3" recovery-cool-down="10"
+                   latency-slo-ms="500"/>
           <storage dir="/data/raft"/>
         </raft>
     """
@@ -217,6 +225,7 @@ def load_xml_config(path: str) -> RaftConfig:
         avail_critical_point=attr("metrics", "avail-critical-point", 3, int),
         recovery_cool_down_ticks=attr("metrics", "recovery-cool-down", 10,
                                       int),
+        latency_slo_ms=attr("metrics", "latency-slo-ms", 500.0, float),
         group_queue_cap=attr("engine", "group-queue-cap", 512, int),
         total_queue_cap=attr("engine", "total-queue-cap", 500_000, int),
         busy_threshold=attr("engine", "busy-threshold", 1_000, int),

@@ -78,4 +78,5 @@ class RaftFactory:
             busy_threshold=config.busy_threshold,
             store=self.log_store(config, node_id),
             serializer=self.serializer(config),
+            latency_slo_s=config.latency_slo_ms / 1e3,
         )

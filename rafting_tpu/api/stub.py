@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import random
 import threading
+import time as _time
 from concurrent.futures import Future, TimeoutError as _FutTimeout
 from typing import Any, Optional, Union
 
@@ -142,12 +143,20 @@ class RaftStub:
         """Blocking linearizable read (the read-plane sibling of
         :meth:`execute`); ``timeout`` bounds the whole call including any
         forward-retry chase."""
+        tr = getattr(self._container._node, "_lat", None)
+        t0 = _time.perf_counter() if tr is not None else 0.0
         fut = self.read(query, timeout=timeout)
         try:
-            return fut.result(timeout=timeout)
+            result = fut.result(timeout=timeout)
         except _FutTimeout:
             raise WaitTimeoutError(
                 f"read on {self.name!r} not served in {timeout}s")
+        if tr is not None:
+            # Client-perceived wall time — queueing, ReadIndex barrier
+            # and any forward chase included (utils/latency.py parks the
+            # sample in this thread's ring; the tick thread merges it).
+            tr.observe_client(_time.perf_counter() - t0, read=True)
+        return result
 
     # Pre-log refusals are identified by the as_refusal marker set at
     # their creation sites (api/anomaly.py) — never by exception type or
@@ -368,12 +377,20 @@ class RaftStub:
         command/RaftStub.java:47-58).  ``timeout`` bounds the whole call,
         INCLUDING any forward-retry chase (the per-call budget the
         advisor's r4 finding asked for)."""
+        tr = getattr(self._container._node, "_lat", None)
+        t0 = _time.perf_counter() if tr is not None else 0.0
         fut = self.submit(command, timeout=timeout)
         try:
-            return fut.result(timeout=timeout)
+            result = fut.result(timeout=timeout)
         except _FutTimeout:
             raise WaitTimeoutError(
                 f"command on {self.name!r} not committed in {timeout}s")
+        if tr is not None:
+            # Client-perceived wall time — queueing, commit/apply wait
+            # and any forward chase included (sample parks in this
+            # thread's ring; the tick thread merges it at harvest).
+            tr.observe_client(_time.perf_counter() - t0)
+        return result
 
     @property
     def leader_hint(self) -> Optional[int]:

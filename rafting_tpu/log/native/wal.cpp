@@ -145,6 +145,17 @@ struct Wal {
   int64_t sync_delay_us = 0;
   bool poisoned = false;
   int last_errno = 0;
+  // Cumulative per-handle (= per-stripe) instrumentation, exported
+  // zero-copy via wal_stats().  Atomics because wal_pack_ae workers reach
+  // arbitrary shards (`gs[c] % n_shards`); the stage/fsync writers are
+  // single-threaded per handle by contract, so relaxed ordering suffices.
+  std::atomic<uint64_t> stat_stage_ns{0};
+  std::atomic<uint64_t> stat_fsync_ns{0};
+  std::atomic<uint64_t> stat_pack_ns{0};
+  std::atomic<uint64_t> stat_bytes{0};
+  std::atomic<uint64_t> stat_stage_calls{0};
+  std::atomic<uint64_t> stat_fsync_calls{0};
+  std::atomic<uint64_t> stat_pack_calls{0};
 };
 
 // Countdown semantics: after=N arms the fault for the (N+1)-th guarded call.
@@ -421,6 +432,8 @@ template <typename PtrAt>
 void stage_rows_impl(Wal& w, uint64_t n, const uint32_t* groups,
                      const uint64_t* idxs, const int64_t* terms,
                      const uint32_t* lens, PtrAt ptr_at) {
+  if (n == 0) return;
+  const double stat_t0 = mono_s();
   uint64_t total = 0;
   for (uint64_t i = 0; i < n; i++) total += 37u + (uint64_t)lens[i];
   w.buf.reserve(w.buf.size() + total);
@@ -474,6 +487,10 @@ void stage_rows_impl(Wal& w, uint64_t n, const uint32_t* groups,
                      // their (seg, off) and are unaffected.
     }
   }
+  w.stat_stage_ns.fetch_add((uint64_t)((mono_s() - stat_t0) * 1e9),
+                            std::memory_order_relaxed);
+  w.stat_bytes.fetch_add(total, std::memory_order_relaxed);
+  w.stat_stage_calls.fetch_add(1, std::memory_order_relaxed);
 }
 
 // Split [0, n_items) into one contiguous chunk per worker; worker 0 runs
@@ -556,6 +573,9 @@ void wal_append_entry(void* h, uint32_t group, uint64_t index, int64_t term,
   gs.entries[index] = EntryRef{term, w->seg_id, body_off + 25, plen};
   gs.tail = (int64_t)index;
   frame(w->buf, body);
+  w->stat_bytes.fetch_add(12u + 25u + (uint64_t)plen,
+                          std::memory_order_relaxed);
+  w->stat_stage_calls.fetch_add(1, std::memory_order_relaxed);
   maybe_rotate(*w);
 }
 
@@ -601,6 +621,9 @@ void wal_reset(void* h, uint32_t group) {
 int wal_sync(void* h) {
   Wal* w = (Wal*)h;
   if (w->poisoned) return -1;  // fail-stop: never fsync a failed fd again
+  // Timed from here so injected sync delays (the slow-I/O gray-failure
+  // simulation) show up in stat_fsync_ns exactly as a real slow disk would.
+  const double stat_t0 = mono_s();
   if (w->sync_delay_us > 0) ::usleep((useconds_t)w->sync_delay_us);
   if (!flush_buf(*w)) return -1;
   if (fault_fire(w->fault_fsync_after)) {
@@ -616,6 +639,9 @@ int wal_sync(void* h) {
     w->poisoned = true;
     return -1;
   }
+  w->stat_fsync_ns.fetch_add((uint64_t)((mono_s() - stat_t0) * 1e9),
+                             std::memory_order_relaxed);
+  w->stat_fsync_calls.fetch_add(1, std::memory_order_relaxed);
   return 0;
 }
 
@@ -1074,6 +1100,21 @@ int wal_poisoned(void* h) { return ((Wal*)h)->poisoned ? 1 : 0; }
 
 int wal_last_errno(void* h) { return ((Wal*)h)->last_errno; }
 
+// Zero-copy stats export: fill the caller's 7-slot u64 buffer with this
+// handle's cumulative {stage_ns, fsync_ns, pack_ns, bytes, stage_calls,
+// fsync_calls, pack_calls}.  Counters are never reset — the Python side
+// keeps the last snapshot and folds deltas into the metrics registry.
+void wal_stats(void* h, uint64_t* out) {
+  Wal* w = (Wal*)h;
+  out[0] = w->stat_stage_ns.load(std::memory_order_relaxed);
+  out[1] = w->stat_fsync_ns.load(std::memory_order_relaxed);
+  out[2] = w->stat_pack_ns.load(std::memory_order_relaxed);
+  out[3] = w->stat_bytes.load(std::memory_order_relaxed);
+  out[4] = w->stat_stage_calls.load(std::memory_order_relaxed);
+  out[5] = w->stat_fsync_calls.load(std::memory_order_relaxed);
+  out[6] = w->stat_pack_calls.load(std::memory_order_relaxed);
+}
+
 // ---------------------------------------------------------------------------
 // Native host tier: the per-stripe persist hot loop behind ONE ctypes call.
 //
@@ -1276,6 +1317,7 @@ int64_t wal_pack_ae(void** handles, uint32_t n_shards, uint32_t n_workers,
          c++) {
       if (!ok_out[c] || ns[c] == 0) continue;  // heartbeats carry no bytes
       Wal* w = (Wal*)handles[gs[c] % n_shards];
+      const double pack_t0 = mono_s();
       auto git = w->groups.find(gs[c]);
       if (git == w->groups.end()) { fail.store(true); break; }
       auto it = git->second.entries.find(starts[c]);
@@ -1294,6 +1336,9 @@ int64_t wal_pack_ae(void** handles, uint32_t n_shards, uint32_t n_workers,
         }
         pp += r.len;
       }
+      w->stat_pack_ns.fetch_add((uint64_t)((mono_s() - pack_t0) * 1e9),
+                                std::memory_order_relaxed);
+      w->stat_pack_calls.fetch_add(1, std::memory_order_relaxed);
     }
     drop_segmaps(maps);
   });

@@ -232,8 +232,21 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.wal_poisoned.argtypes = [ctypes.c_void_p]
             lib.wal_last_errno.restype = ctypes.c_int
             lib.wal_last_errno.argtypes = [ctypes.c_void_p]
+        # Per-stripe instrumentation export (hasattr-guarded like the host
+        # tier: a stale prebuilt .so still serves the classic surface).
+        if hasattr(lib, "wal_stats"):
+            lib.wal_stats.restype = None
+            lib.wal_stats.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
         _lib = lib
         return lib
+
+
+# wal_stats() export order — one schema for both engines (and the merged
+# ShardedWal view): cumulative ns spent staging / fsyncing / packing, bytes
+# staged, and call counts.  Counters never reset; consumers keep the last
+# snapshot and fold deltas into the metrics registry.
+WAL_STAT_KEYS = ("stage_ns", "fsync_ns", "pack_ns", "bytes",
+                 "stage_calls", "fsync_calls", "pack_calls")
 
 
 def native_available() -> bool:
@@ -376,6 +389,15 @@ class _NativeWal:
         if not self._h or not hasattr(self._lib, "wal_last_errno"):
             return 0
         return int(self._lib.wal_last_errno(self._h))
+
+    def stats(self) -> Dict[str, int]:
+        """Cumulative per-stripe instrumentation (WAL_STAT_KEYS), read
+        zero-copy from the engine's atomic counters."""
+        if not self._h or not hasattr(self._lib, "wal_stats"):
+            return dict.fromkeys(WAL_STAT_KEYS, 0)
+        out = (ctypes.c_uint64 * len(WAL_STAT_KEYS))()
+        self._lib.wal_stats(self._h, out)
+        return dict(zip(WAL_STAT_KEYS, (int(v) for v in out)))
 
     def _raise_sync_error(self):
         msg = self.error() or "wal_sync failed"
@@ -671,6 +693,11 @@ class PyWal:
         self._err = ""
         self._faults: Dict[str, list] = {}  # op -> [after, value]
         self._sync_delay_us = 0
+        # Same stats schema as the native engine (WAL_STAT_KEYS).
+        # stage_ns stays 0 here: Python staging is interleaved with the
+        # caller's own loop, so a per-record clock read would measure the
+        # clock, not the work; bytes/calls and fsync timing are exact.
+        self._stat = dict.fromkeys(WAL_STAT_KEYS, 0)
 
     def _seg_path(self, sid):
         return os.path.join(self.dir, f"{sid:08d}.wal")
@@ -714,6 +741,8 @@ class PyWal:
     def _emit(self, body: bytes):
         self._buf += struct.pack("<III", _MAGIC, len(body), zlib.crc32(body))
         self._buf += body
+        self._stat["bytes"] += 12 + len(body)
+        self._stat["stage_calls"] += 1
         if self._f.tell() + len(self._buf) >= self.segment_bytes:
             if not self._flush():
                 return  # failure surfaces at the sync barrier
@@ -807,6 +836,9 @@ class PyWal:
     def sync(self):
         if self.poisoned:
             self._raise_sync_error()
+        # Timed from here (incl. injected sync delays) to mirror the
+        # native engine's wal_sync stats window.
+        _t0 = time.perf_counter()
         if self._sync_delay_us > 0:
             time.sleep(self._sync_delay_us / 1e6)
         if not self._flush():
@@ -821,6 +853,11 @@ class PyWal:
             self._latch(e)
             self.poisoned = True
             self._raise_sync_error()
+        self._stat["fsync_ns"] += int((time.perf_counter() - _t0) * 1e9)
+        self._stat["fsync_calls"] += 1
+
+    def stats(self) -> Dict[str, int]:
+        return dict(self._stat)
 
     def tail(self, g):
         return self.groups[g].tail if g in self.groups else 0
@@ -1299,6 +1336,19 @@ class ShardedWal:
 
     def poisoned_shards(self):
         return [k for k, e in enumerate(self.engines) if e.poisoned]
+
+    # -- instrumentation -----------------------------------------------
+    def stats(self) -> Dict[str, int]:
+        """Sum of per-stripe engine stats (WAL_STAT_KEYS)."""
+        out = dict.fromkeys(WAL_STAT_KEYS, 0)
+        for e in self.engines:
+            for k, v in e.stats().items():
+                out[k] += v
+        return out
+
+    def stats_per_stripe(self):
+        """Per-stripe stats, index-aligned with the engine list."""
+        return [e.stats() for e in self.engines]
 
     # -- per-group reads -----------------------------------------------
     def tail(self, g):

@@ -24,6 +24,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from .spi import MachineProvider, RaftMachine
+from ..utils.latency import APPLIED as _APPLIED
 
 log = logging.getLogger(__name__)
 
@@ -185,6 +186,12 @@ class ApplyDispatcher:
             comp = r.sink._complete
             base_k = r.k0 + (a - r.start)
             base_r = a - lo
+            sp = getattr(r.sink, "span", None)   # sampled lifecycle span
+            if sp is not None and base_k <= sp.k <= base_k + (b - a):
+                # Stamped BEFORE the completion loop: the batch's ack
+                # stamp fires inside _complete when its last slot lands,
+                # and applied must precede acked (utils/latency.py).
+                sp.mark(_APPLIED)
             for j in range(b - a + 1):
                 comp(base_k + j, results[base_r + j])
             if b < end:

@@ -64,6 +64,9 @@ from ..api.anomaly import (
     ObsoleteContextError, StorageFaultError, as_refusal,
 )
 from ..log.wal import WalNoSpace, WalSyncError
+from ..utils.latency import (
+    ACKED, FSYNCED, OFFERED, SENT, SERVED, STAGED, tracer_from_env,
+)
 from ..utils.metrics import Metrics
 from ..utils.profiling import TickProfiler
 from ..utils.tracelog import TraceLog
@@ -90,7 +93,7 @@ class BatchSubmit:
     silently discarded."""
 
     __slots__ = ("_future", "results", "completed", "_remaining", "single",
-                 "_err")
+                 "_err", "span")
 
     # One shared lock for the lazy-future handoff (creation vs completion
     # can race across client and tick threads).  Class-level on purpose: a
@@ -110,6 +113,11 @@ class BatchSubmit:
         self._remaining = n
         self.single = single
         self._err: Optional[Exception] = None
+        # Sampled lifecycle span riding this batch (utils/latency.py) —
+        # at most one entry per batch is traced, so the per-entry
+        # _complete loop stays span-free; the ack stamp fires once, when
+        # the batch resolves.
+        self.span = None
 
     @property
     def future(self) -> Future:
@@ -134,6 +142,10 @@ class BatchSubmit:
         self.completed[k] = True
         self._remaining -= 1
         if self._remaining == 0:
+            sp = self.span
+            if sp is not None:
+                sp.mark(ACKED if sp.kind == "w" else SERVED)
+                sp.tr.retire(sp, "ok")
             with self._lock:
                 f = self._future
             if f is not None and not f.done():
@@ -141,6 +153,12 @@ class BatchSubmit:
                     self.results[0] if self.single else self.results)
 
     def _fail(self, err: Exception) -> None:
+        sp = self.span
+        if sp is not None:
+            # The batch died after (possibly) entering the log: the
+            # entry MAY still commit on a new leader — outcome-unknown,
+            # never a fabricated latency (utils/latency.py).
+            sp.tr.retire(sp, "unknown")
         wrapped = err if self.single else BatchAbortedError(
             err, list(self.results), list(self.completed))
         with self._lock:
@@ -154,6 +172,9 @@ class BatchSubmit:
         """Pre-log refusal of the WHOLE batch: nothing was enqueued, so the
         future carries the bare (marked) refusal — not a BatchAbortedError
         — matching submit_batch's refusal contract."""
+        sp = self.span
+        if sp is not None:
+            sp.tr.retire(sp, "refused")   # provably never entered the log
         with self._lock:
             if self._err is None:
                 self._err = err
@@ -254,7 +275,8 @@ class RaftNode:
                  serializer=None,
                  pipeline: Optional[bool] = None,
                  wal_shards: Optional[int] = None,
-                 host_workers: Optional[int] = None):
+                 host_workers: Optional[int] = None,
+                 latency_slo_s: Optional[float] = None):
         """``transport_factory(node, on_slice, snapshot_provider)`` builds
         the transport endpoint (TcpTransport / LoopbackTransport).
         ``initial_active`` masks which group lanes start open (default all;
@@ -283,7 +305,10 @@ class RaftNode:
         each owning a disjoint, WAL-stripe-aligned set of groups
         end-to-end (see _host_phase_striped).  1 (the default, or env
         RAFT_HOST_WORKERS) keeps the classic serial host phase; the
-        effective width is clamped to the store's stripe count."""
+        effective width is clamped to the store's stripe count.
+        ``latency_slo_s``: end-to-end commit-latency SLO target the
+        latency plane's burn gauges measure against (utils/latency.py)
+        — default env RAFT_SLO_MS (milliseconds), else 500ms."""
         from ..api.serial import JsonSerializer
 
         self.cfg = cfg
@@ -609,6 +634,27 @@ class RaftNode:
         # The transport reports its own health (reconnects_total) into
         # the node registry; set before start() spawns sender threads.
         self.transport.metrics = self.metrics
+        # Per-entry commit-path latency plane (utils/latency.py): a
+        # seeded deterministic sampler stamps span records through
+        # submitted -> offered -> staged -> fsynced -> sent -> committed
+        # -> applied -> acked (served for reads).  RAFT_LAT_SAMPLE=0
+        # disables it entirely — the node holds None and every hot-path
+        # hook is one is-None check.
+        if latency_slo_s is None:
+            latency_slo_s = float(
+                os.environ.get("RAFT_SLO_MS", "500")) / 1e3
+        self._lat = tracer_from_env(seed=seed, slo_s=latency_slo_s)
+        # Spans offered to the device THIS tick, awaiting the tick's
+        # staged/fsynced/sent stamps (tick/host-phase thread only).
+        self._lat_tick: list = []
+        # Recent striped-tier per-worker (stage, fsync, send, apply)
+        # wall times for /timeline + debug dumps; inert in serial mode.
+        self._worker_util: deque = deque(maxlen=256)
+        # Last native/Python WAL-engine stats snapshot (cumulative
+        # counters — _fold_wal_stats folds deltas into the registry).
+        self._wal_stat_last: Optional[dict] = None
+        self.metrics.gauge(
+            "lat_sample_rate", self._lat.rate if self._lat else 0)
         # Flight-recorder drain (cfg.trace_depth > 0): per-group decoded
         # timelines + labeled metrics (elections by cause, leader churn)
         # harvested from the device event rings each tick.  Inert when
@@ -671,6 +717,25 @@ class RaftNode:
             self._obsrv = ObservabilityServer(self, host, port).start()
         return self._obsrv
 
+    def latency_snapshot(self) -> dict:
+        """The /latency document (runtime/obsrv.py): sampler state, SLO
+        burn, per-phase and end-to-end percentiles, recent sampled spans
+        — plus the WAL engines' per-stripe stage/fsync/pack counters and
+        the striped tier's recent per-worker utilization.  Snapshot
+        reads only; safe off the tick thread (same contract as
+        /metrics)."""
+        tr = self._lat
+        doc = {"enabled": tr is not None}
+        if tr is not None:
+            doc.update(tr.snapshot(self.metrics))
+        wal = getattr(self.store, "wal", None)
+        per = getattr(wal, "stats_per_stripe", None)
+        if per is not None:
+            doc["wal_stripes"] = [
+                dict(s, stripe=i) for i, s in enumerate(per())]
+        doc["worker_util"] = list(self._worker_util)
+        return doc
+
     def close(self) -> None:
         self._stop.set()
         if self._thread is not None:
@@ -687,6 +752,11 @@ class RaftNode:
             except Exception:
                 log.exception("node %d: pipeline drain failed on close",
                               self.node_id)
+        if self._lat is not None:
+            # Final harvest: retired-but-unmerged spans land in the
+            # histograms before the registry goes quiet (spans still in
+            # flight stay un-counted — never a fabricated latency).
+            self._lat.harvest(self.metrics)
         if self._obsrv is not None:
             self._obsrv.close()
             self._obsrv = None
@@ -730,7 +800,8 @@ class RaftNode:
         if self._host_pool is not None:
             self._host_pool.shutdown(wait=True)
             self._host_pool = None
-        self.store.close()
+        self._fold_wal_stats()   # final engine-counter fold (short runs
+        self.store.close()       # never reach a 32-tick maintain pass)
 
     def submit(self, group: int, payload: bytes) -> Future:
         """Offer a command to the group's replicated log.  The returned
@@ -773,6 +844,11 @@ class RaftNode:
                 _SubBatch(run, sink))
             self._queued_n[group] += 1
             self._queued_total += 1
+            tr = self._lat
+            if tr is not None:
+                seq = tr.next_seq_w(1)
+                if tr.sampled(seq):
+                    sink.span = tr.make_span(seq, "w", 0)
         return fut
 
     def submit_batch(self, group: int, payloads) -> Future:
@@ -809,6 +885,12 @@ class RaftNode:
                 _SubBatch(run, batch))
             self._queued_n[group] += n
             self._queued_total += n
+            tr = self._lat
+            if tr is not None:
+                seq0 = tr.next_seq_w(n)
+                k = tr.first_in(seq0, n)
+                if k >= 0:
+                    batch.span = tr.make_span(seq0 + k, "w", k)
         return fut
 
     def submit_batch_many(self, groups, payloads) -> List[BatchSubmit]:
@@ -836,6 +918,7 @@ class RaftNode:
         leader, qn = self.h_leader, self._queued_n
         hg, bp = self._healthy_groups, self._io_backpressure
         cap = self.group_queue_cap - n
+        tr = self._lat
         with self._submit_lock:
             headroom = (self.total_queue_cap - self.busy_threshold
                         - self._queued_total)
@@ -875,6 +958,15 @@ class RaftNode:
                 qn[g] += n
                 self._queued_total += n
                 headroom -= n
+                if tr is not None:
+                    # Seqs are allocated per ACCEPTED group only (the
+                    # sampled set is deterministic over accepted
+                    # submissions); first_in is O(1), so the 100k-group
+                    # fan-out never loops to decide.
+                    seq0 = tr.next_seq_w(n)
+                    k = tr.first_in(seq0, n)
+                    if k >= 0:
+                        sink.span = tr.make_span(seq0 + k, "w", k)
         return sinks
 
     def read(self, group: int, payload: bytes) -> Future:
@@ -919,6 +1011,15 @@ class RaftNode:
             self._reads_waiting.setdefault(group, deque()).append(
                 _ReadBatch(list(payloads), sink, time.monotonic()))
             self._read_queued_n[group] += n
+            tr = self._lat
+            if tr is not None:
+                seq0 = tr.next_seq_r(n)
+                k = tr.first_in(seq0, n)
+                if k >= 0:
+                    sp = tr.make_span(seq0 + k, "r", k)
+                    if sp is not None:
+                        sp.group = group
+                    sink.span = sp
         return fut
 
     def _refusal(self, group: int) -> Optional[Exception]:
@@ -1061,6 +1162,11 @@ class RaftNode:
                 self._host_phase(ctx)
         self.metrics.observe("tick_latency_s",
                              time.perf_counter() - _tick_t0)
+        if self._lat is not None:
+            # Merge retired spans from every thread's ring into the
+            # shared histograms — tick thread only, so the registry
+            # keeps its single-writer contract (utils/metrics.py).
+            self._lat.harvest(self.metrics)
         self.profiler.after_tick()
         return ctx.info
 
@@ -1373,6 +1479,14 @@ class RaftNode:
             self._inflight_submit = self._inflight_submit - ctx.submit_n
             self._inflight_read = self._inflight_read - ctx.read_n
 
+    def _lat_stamp(self, phase: int) -> None:
+        """Stamp one lifecycle phase on every span the device accepted
+        this tick (populated by _persist_prepare's submission pop; tick /
+        host-phase thread only).  One is-None-cheap loop over at most a
+        handful of sampled spans."""
+        for sp in self._lat_tick:
+            sp.mark(phase)
+
     def _host_phase_serial(self, ctx: _TickCtx, defer_send: bool) -> None:
         G = self.cfg.n_groups
         _t0 = time.perf_counter()
@@ -1389,10 +1503,14 @@ class RaftNode:
         self._sweep_rejections(prep)
         ctx.staged_payloads = ctx.arrays = None   # drop frame pins early
         _t1 = time.perf_counter()
+        if self._lat_tick:
+            self._lat_stamp(STAGED)
         if need_sync or self._sync_pending:
             self._barrier()     # THE durability barrier
             self._barrier_ok()
         _t2 = time.perf_counter()
+        if self._lat_tick:
+            self._lat_stamp(FSYNCED)
         self._watch_io(_t2 - _t1)
 
         # -- 5. release outbox (only ever after the barrier) -----------------
@@ -1403,8 +1521,14 @@ class RaftNode:
         if not defer_send:
             self._flush_sends()
         _t3 = time.perf_counter()
+        if self._lat_tick:
+            self._lat_stamp(SENT)
 
         # -- 6. applies ------------------------------------------------------
+        if self._lat is not None:
+            # Commit stamps strictly precede apply/ack stamps: advance()
+            # completes promises (and the traced batch's ack) below.
+            self._lat.mark_committed(ctx.commit)
         before = self.dispatcher.applied_frontier(G)
         self.dispatcher.advance(ctx.commit)
         after = self.dispatcher.applied_frontier(G)
@@ -1513,12 +1637,22 @@ class RaftNode:
         # sweeps touch the submit lock.
         self.store.conf_flush()
         self._barrier_ok()
+        if self._lat_tick:
+            # Staged/fsynced resolve at the Phase A barrier (per-stripe
+            # stage and fsync interleave inside the workers, so the
+            # stamps share the all-shards-durable instant).
+            self._lat_stamp(STAGED)
+            self._lat_stamp(FSYNCED)
         self._sweep_rejections(prep)
         ctx.staged_payloads = ctx.arrays = None
 
         self.dispatcher.warm_mirror(G)
         before = self.dispatcher.applied_frontier(G)
         groups = self._worker_groups
+        if self._lat is not None:
+            # Commit stamps strictly precede apply/ack stamps: Phase B's
+            # advance() completes promises (and the traced batch's ack).
+            self._lat.mark_committed(ctx.commit)
 
         def _phase_b(k: int):
             b0 = time.perf_counter()
@@ -1535,6 +1669,8 @@ class RaftNode:
                 self._held_sections.setdefault(p, []).extend(secs)
         if not defer_send:
             self._flush_sends()
+        if self._lat_tick:
+            self._lat_stamp(SENT)
         after = self.dispatcher.applied_frontier(G)
         self.metrics["applies"] += int((after - before).sum())
         self.metrics["commits"] = int(ctx.commit.astype(np.int64).sum())
@@ -1562,6 +1698,13 @@ class RaftNode:
             m.observe("stripe_busy_s",
                       res_a[k][0] + res_a[k][1]
                       + res_b[k][1] + res_b[k][2])
+        # Per-worker utilization intervals for /timeline + debug dumps:
+        # (stage, fsync, send, apply) wall seconds per worker this tick.
+        self._worker_util.append(
+            {"tick": self.ticks,
+             "workers": [[round(res_a[k][0], 6), round(res_a[k][1], 6),
+                          round(res_b[k][1], 6), round(res_b[k][2], 6)]
+                         for k in range(W)]})
 
     def _host_phase_native(self, ctx: _TickCtx, defer_send: bool) -> None:
         """The native host phase: the tick's durable hot loop — arena
@@ -1592,6 +1735,11 @@ class RaftNode:
         # the submit lock.
         self.store.conf_flush()
         self._barrier_ok()
+        if self._lat_tick:
+            # One C call stages AND fsyncs — both stamps resolve at its
+            # return (the split lives in the engine's wal_stats()).
+            self._lat_stamp(STAGED)
+            self._lat_stamp(FSYNCED)
         self._sweep_rejections(prep)
         # The native call is done — the arena views the spans pinned are
         # no longer referenced from C.
@@ -1606,7 +1754,11 @@ class RaftNode:
         if not defer_send:
             self._flush_sends()
         _t3 = time.perf_counter()
+        if self._lat_tick:
+            self._lat_stamp(SENT)
 
+        if self._lat is not None:
+            self._lat.mark_committed(ctx.commit)
         before = self.dispatcher.applied_frontier(G)
         self.dispatcher.advance(ctx.commit)
         after = self.dispatcher.applied_frontier(G)
@@ -1871,6 +2023,9 @@ class RaftNode:
         # promise-range registration happens in the stage, outside it.
         own_by_g: Dict[int, List[tuple]] = {}
         sub_groups = wrote[sub_acc[wrote] > 0]
+        tr = self._lat
+        lat_tick = self._lat_tick
+        lat_tick.clear()
         if len(sub_groups):
             with self._submit_lock:
                 for g in sub_groups.tolist():
@@ -1890,6 +2045,20 @@ class RaftNode:
                         avail = len(b.run) - b.taken
                         take = min(avail, need)
                         taken_spans.append((cursor, b, b.taken, take))
+                        if tr is not None:
+                            sp = b.sink.span
+                            if sp is not None and sp.outcome is None \
+                                    and b.taken <= sp.k < b.taken + take:
+                                # Device accepted the traced entry: pin
+                                # its (group, log index) and queue it
+                                # for this tick's durability stamps and
+                                # the cross-tick commit watch.
+                                sp.group = g
+                                sp.idx = cursor + (sp.k - b.taken)
+                                sp.tick = self.ticks
+                                sp.mark(OFFERED)
+                                lat_tick.append(sp)
+                                tr.pending_commit.append(sp)
                         b.taken += take
                         cursor += take
                         need -= take
@@ -2839,9 +3008,29 @@ class RaftNode:
         self._compact_grant = self.maintain.compact_targets(
             now, self.h_commit.astype(np.int64), h_base.astype(np.int64))
         self._maintain_gc(now)
+        if now % 32 == 0:
+            self._fold_wal_stats()
         if self.scrub_interval_ticks \
                 and now % self.scrub_interval_ticks == 0:
             self._scrub_archive()
+
+    def _fold_wal_stats(self) -> None:
+        """Fold the WAL engines' cumulative stage/fsync/pack counters
+        (native wal_stats() or the PyWal mirror — log/wal.py) into the
+        metrics registry as wal_* counters.  The engine counters never
+        reset; this keeps the last snapshot and folds deltas, so the
+        registry survives engine reopen (a fresh engine restarts at 0
+        and the max(0, ...) clamp drops the negative delta)."""
+        wal = getattr(self.store, "wal", None)
+        stats = getattr(wal, "stats", None)
+        if stats is None:
+            return
+        cur = stats()
+        last = self._wal_stat_last or {}
+        m = self.metrics
+        for k, v in cur.items():
+            m[f"wal_{k}"] += max(0, v - last.get(k, 0))
+        self._wal_stat_last = cur
 
     def _scrub_archive(self) -> None:
         """Background snapshot scrubber: one budgeted verify pass —

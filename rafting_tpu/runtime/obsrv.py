@@ -14,7 +14,12 @@ curl and scraped by Prometheus, with no new dependencies:
   (reference Leader.isReady, Leader.java:52-64), plus tick/uptime vitals;
 * ``GET /timeline?group=N``   — the flight recorder's decoded per-group
   event timeline (``utils/tracelog.TraceLog``), the "which replica did
-  what when" view; empty unless ``cfg.trace_depth > 0``.
+  what when" view; empty unless ``cfg.trace_depth > 0`` — plus the
+  striped host tier's recent per-worker utilization intervals;
+* ``GET /latency``            — the sampled commit-path latency plane
+  (``utils/latency.py``): sampler state, SLO burn, per-phase and
+  end-to-end percentile tables, recent sampled spans with per-phase
+  breakdowns, and the WAL engines' per-stripe stage/fsync/pack stats.
 
 Handlers only READ tick-refreshed host mirrors (``h_role``/``h_ready``/
 ``metrics``/``tracelog``) — the same bounded one-tick staleness contract
@@ -82,10 +87,13 @@ class ObservabilityServer:
                             self._json(400, {"error": "bad group"})
                             return
                         self._json(200, outer.timeline(g))
+                    elif url.path == "/latency":
+                        self._json(200, outer.node.latency_snapshot())
                     else:
                         self._json(404, {"error": "unknown path",
                                          "paths": ["/metrics", "/healthz",
-                                                   "/timeline?group=N"]})
+                                                   "/timeline?group=N",
+                                                   "/latency"]})
                 except BrokenPipeError:
                     pass
 
@@ -113,6 +121,18 @@ class ObservabilityServer:
             "backpressure": bool(getattr(n, "_io_backpressure", False)),
             "io_slow": bool(getattr(n, "_io_slow", False)),
         }
+        # Latency vitals (the PR 13 latency plane): is the fleet meeting
+        # its end-to-end SLO?  p999 + burn come from the same registry
+        # gauges /metrics exports; sampling=0 means the plane is off.
+        tr = getattr(n, "_lat", None)
+        gauges = n.metrics._gauges
+        latency = {
+            "sampling_rate": tr.rate if tr is not None else 0,
+            "slo_target_s": (tr.slo_s if tr is not None else 0.0),
+            "e2e_p999_s": float(gauges.get("lat_e2e_p999_s", 0.0)),
+            "slo_burn_ratio": float(gauges.get("lat_slo_burn_ratio", 0.0)),
+            "io_slow": bool(getattr(n, "_io_slow", False)),
+        }
         return {
             "ok": True,
             "node_id": int(n.node_id),
@@ -121,6 +141,7 @@ class ObservabilityServer:
             "groups_led": led,
             "groups_ready": ready,
             "storage": storage,
+            "latency": latency,
             "trace_depth": int(n.cfg.trace_depth),
             "uptime_s": round(time.monotonic() - self._t0, 3),
         }
@@ -132,6 +153,9 @@ class ObservabilityServer:
             "trace_depth": int(n.cfg.trace_depth),
             "events": n.tracelog.timeline(g),
             "dropped_total": int(n.tracelog.dropped_total),
+            # Striped host tier: recent per-worker (stage, fsync, send,
+            # apply) wall seconds per tick — empty in serial mode.
+            "worker_util": list(getattr(n, "_worker_util", ())),
         }
 
     # --------------------------------------------------------- lifecycle --

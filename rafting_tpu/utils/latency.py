@@ -1,0 +1,311 @@
+"""Per-entry commit-path latency tracing (the sampled span plane).
+
+Every signal the runtime exported before this module was per-tick: the
+stage histograms and the flight recorder measure what a *tick* costs,
+never what one *command* experienced from submit to ack.  CD-Raft
+(arXiv:2603.10555) and "Paxos vs Raft" (arXiv:2004.05074) both frame
+consensus quality as end-to-end commit latency — and the ROADMAP's
+"millions of users" claim is a p999 claim, so the runtime needs to know
+where the microseconds go per entry, not per tick.
+
+Design:
+
+* **Sampling** is a seeded stride: submission seq ``s`` is sampled iff
+  ``(s + seed % rate) % rate == 0`` (~1/rate of submits).  The sampled
+  SET is a pure function of (seed, rate) — same seed, same set — and
+  membership of a contiguous seq range [s0, s0+n) is O(1) arithmetic
+  (``first_in``), so the 100k-group fan-out path never loops to decide.
+  ``rate=0`` disables the plane entirely: the node holds no tracer and
+  every hot-path hook is one attribute-is-None check.
+* **Spans** stamp wall-clock marks through the commit path:
+  ``submitted → offered → staged → fsynced → sent → committed →
+  applied → acked`` (writes) and ``submitted → served`` (reads).  A
+  span that dies before its ack — leadership loss, storage fault, lane
+  close — retires with ``outcome-unknown`` (or ``refused`` for marked
+  pre-log refusals) and contributes NO latency sample: a crashed span
+  must never fabricate a latency.
+* **Rings**: spans retire into per-thread ring buffers (client threads,
+  stripe workers, the tick thread each own one deque; registration of
+  a new ring takes the only lock in the retire path).  The tick thread
+  merges rings at :meth:`harvest` and is the sole writer of the shared
+  histograms — the registry keeps its single-writer contract (see
+  utils/metrics.py) with W striped workers in play.
+* **Admission** is bounded (``max_live``): the sampler's *selection* is
+  deterministic, but at most ``max_live`` spans are in flight at once —
+  overflow candidates are counted (``span_overflow``), not traced, so
+  a 100k-group burst cannot turn the trace plane into the workload.
+
+Histograms land in the node's Metrics registry as ``lat_<pair>_s``
+per phase pair plus ``lat_e2e_s`` / ``lat_read_e2e_s`` end-to-end, so
+/metrics exposition and /latency percentiles come from one source.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+# Phase indices (Span.t slots).
+SUBMITTED, OFFERED, STAGED, FSYNCED, SENT, COMMITTED, APPLIED, ACKED, \
+    SERVED = range(9)
+
+PHASE_NAMES = ("submitted", "offered", "staged", "fsynced", "sent",
+               "committed", "applied", "acked", "served")
+
+# Adjacent phase pairs reported as histograms (writes).  Summing these
+# medians ≈ the e2e median on an idle cluster (the reconciliation the
+# acceptance criteria check).
+PHASE_PAIRS = (
+    ("submit_offer", SUBMITTED, OFFERED),
+    ("offer_stage", OFFERED, STAGED),
+    ("stage_fsync", STAGED, FSYNCED),
+    ("fsync_send", FSYNCED, SENT),
+    ("send_commit", SENT, COMMITTED),
+    ("commit_apply", COMMITTED, APPLIED),
+    ("apply_ack", APPLIED, ACKED),
+)
+
+
+class Span:
+    """One sampled entry's lifecycle record.  Mutated by whichever
+    thread reaches the stamp site; each slot has exactly one writer per
+    lifecycle (the stamp sites are ordered by the commit protocol), so
+    no locking — a torn read can only be observed by the harvester for
+    an outcome-unknown span, which reports no latency anyway."""
+
+    __slots__ = ("seq", "kind", "k", "group", "idx", "tick", "t",
+                 "outcome", "tr")
+
+    def __init__(self, seq: int, kind: str, k: int):
+        self.seq = seq
+        self.kind = kind          # "w" (write) | "r" (read)
+        self.k = k                # entry offset within its batch
+        self.group = -1
+        self.idx = -1             # log index (writes; stamped at offer)
+        self.tick = -1            # node tick at device accept — the
+        #                           shared axis flight-recorder events
+        #                           and worker-util intervals plot on
+        self.t = [0.0] * 9
+        self.outcome: Optional[str] = None   # None=in flight, "ok",
+        #                                      "unknown", "refused"
+        self.tr: Optional["LatencyTracer"] = None   # set by make_span —
+        # completion sites (BatchSubmit sinks) retire via the span alone
+
+    def mark(self, phase: int) -> None:
+        if self.t[phase] == 0.0:
+            self.t[phase] = time.perf_counter()
+
+    def to_dict(self) -> dict:
+        """Per-phase breakdown for /latency and save_dump meta: deltas
+        from ``submitted`` (seconds), only for stamped phases."""
+        t0 = self.t[SUBMITTED]
+        phases = {PHASE_NAMES[i]: round(self.t[i] - t0, 9)
+                  for i in range(1, 9) if self.t[i] > 0.0}
+        return {"seq": self.seq, "kind": self.kind, "group": self.group,
+                "idx": self.idx, "k": self.k, "tick": self.tick,
+                "outcome": self.outcome or "in-flight", "phases": phases}
+
+
+class LatencyTracer:
+    """Sampler + span bookkeeping + harvest for one node.
+
+    Thread contract: ``next_seq_w`` is called under the node's submit
+    lock and ``next_seq_r`` under its read lock (the counters need no
+    lock of their own); ``retire`` may run on any thread (per-thread
+    rings); ``harvest``/``mark_committed``/``tick_spans`` run on the
+    tick thread only.
+    """
+
+    def __init__(self, rate: int, seed: int = 0, slo_s: float = 0.5,
+                 max_live: int = 512, recent: int = 64):
+        assert rate >= 1
+        self.rate = int(rate)
+        self.seed = int(seed)
+        self.phase = self.seed % self.rate
+        self.slo_s = float(slo_s)
+        self.max_live = int(max_live)
+        self._seq_w = 0           # guarded by the node's submit lock
+        self._seq_r = 0           # guarded by the node's read lock
+        self._live = 0
+        self._live_lock = threading.Lock()
+        self._rings_lock = threading.Lock()
+        self._rings: List[deque] = []
+        self._tls = threading.local()
+        # Tick-thread-only state.
+        self.pending_commit: List[Span] = []   # offered, awaiting commit
+        self.recent: deque = deque(maxlen=recent)
+        self.counts: Dict[str, int] = {
+            "sampled": 0, "ok": 0, "unknown": 0, "refused": 0,
+            "overflow": 0, "slo_violations": 0}
+
+    # -- sampling (pure arithmetic) -------------------------------------
+    def sampled(self, seq: int) -> bool:
+        return (seq + self.phase) % self.rate == 0
+
+    def first_in(self, seq0: int, n: int) -> int:
+        """Offset of the first sampled seq in [seq0, seq0+n), or -1.
+        O(1): the stride has exactly one hit per ``rate`` seqs."""
+        off = (-(seq0 + self.phase)) % self.rate
+        return off if off < n else -1
+
+    def next_seq_w(self, n: int) -> int:
+        s = self._seq_w
+        self._seq_w = s + n
+        return s
+
+    def next_seq_r(self, n: int) -> int:
+        s = self._seq_r
+        self._seq_r = s + n
+        return s
+
+    # -- span lifecycle -------------------------------------------------
+    def make_span(self, seq: int, kind: str, k: int) -> Optional[Span]:
+        """Admit a sampled candidate (bounded by ``max_live``)."""
+        with self._live_lock:
+            if self._live >= self.max_live:
+                self.counts["overflow"] += 1   # GIL-atomic enough: the
+                return None                    # lock serializes writers
+            self._live += 1
+            self.counts["sampled"] += 1
+        sp = Span(seq, kind, k)
+        sp.tr = self
+        sp.mark(SUBMITTED)
+        return sp
+
+    def _ring(self) -> deque:
+        ring = getattr(self._tls, "ring", None)
+        if ring is None:
+            ring = self._tls.ring = deque()
+            with self._rings_lock:
+                self._rings.append(ring)
+        return ring
+
+    def retire(self, sp: Span, outcome: str) -> None:
+        """Finish a span on the CURRENT thread: record its outcome and
+        park it in this thread's ring for the tick thread to harvest.
+        Idempotent — the first outcome wins (an abort racing a late
+        completion must not retire the span twice)."""
+        if sp.outcome is not None:
+            return
+        sp.outcome = outcome
+        self._ring().append(sp)
+        with self._live_lock:
+            self._live -= 1
+
+    def observe_client(self, seconds: float, read: bool = False) -> None:
+        """Any thread (api/stub.py execute / execute_read): park one
+        client-perceived wall time — queueing + forward chase included —
+        in this thread's ring; harvest folds it into
+        ``lat_client_execute_s`` / ``lat_client_read_s``.  Client
+        threads never touch the shared registry (single-writer rule)."""
+        self._ring().append((seconds, read))
+
+    def mark_committed(self, h_commit) -> None:
+        """Tick thread: stamp ``committed`` on in-flight spans whose
+        group's commit frontier reached their log index."""
+        pend = self.pending_commit
+        if not pend:
+            return
+        keep: List[Span] = []
+        for sp in pend:
+            if sp.outcome is not None:
+                continue          # already retired (abort path)
+            if sp.idx >= 0 and int(h_commit[sp.group]) >= sp.idx:
+                sp.mark(COMMITTED)
+            else:
+                keep.append(sp)
+        self.pending_commit = keep
+
+    # -- harvest (tick thread: the registry's single writer) ------------
+    def harvest(self, metrics) -> None:
+        with self._rings_lock:
+            rings = list(self._rings)
+        c = self.counts
+        observe = metrics.observe
+        for ring in rings:
+            while ring:
+                sp = ring.popleft()
+                if sp.__class__ is tuple:     # client wall-time sample
+                    observe("lat_client_read_s" if sp[1]
+                            else "lat_client_execute_s", sp[0])
+                    continue
+                self.recent.append(sp)
+                if sp.outcome != "ok":
+                    c[sp.outcome] = c.get(sp.outcome, 0) + 1
+                    continue      # never fabricate a latency
+                c["ok"] += 1
+                t = sp.t
+                if sp.kind == "r":
+                    if t[SERVED] > 0.0:
+                        observe("lat_read_e2e_s", t[SERVED] - t[SUBMITTED])
+                    continue
+                for name, a, b in PHASE_PAIRS:
+                    if t[a] > 0.0 and t[b] > 0.0:
+                        observe(f"lat_{name}_s", max(0.0, t[b] - t[a]))
+                end = t[ACKED] if t[ACKED] > 0.0 else 0.0
+                if end > 0.0:
+                    e2e = end - t[SUBMITTED]
+                    observe("lat_e2e_s", e2e)
+                    if e2e > self.slo_s:
+                        c["slo_violations"] += 1
+        # Percentile + SLO-burn gauges from the registry's own histogram
+        # (one source for /metrics, /healthz and /latency).
+        h = metrics.histogram("lat_e2e_s")
+        metrics.gauge("lat_e2e_p50_s", h.quantile(0.5))
+        metrics.gauge("lat_e2e_p99_s", h.quantile(0.99))
+        metrics.gauge("lat_e2e_p999_s", h.quantile(0.999))
+        metrics.gauge("lat_slo_target_s", self.slo_s)
+        ok = c["ok"]
+        metrics.gauge("lat_slo_burn_ratio",
+                      c["slo_violations"] / ok if ok else 0.0)
+        metrics["lat_sampled"] = c["sampled"]
+        metrics["lat_spans_ok"] = ok
+        metrics["lat_spans_unknown"] = c["unknown"]
+        metrics["lat_spans_refused"] = c["refused"]
+        metrics["lat_span_overflow"] = c["overflow"]
+
+    # -- views -----------------------------------------------------------
+    def snapshot(self, metrics) -> dict:
+        """The /latency document: sampler config, SLO state, per-phase
+        and end-to-end percentile table, recent sampled spans."""
+        phases = {}
+        for name, _a, _b in PHASE_PAIRS:
+            h = metrics._histograms.get(f"lat_{name}_s")
+            if h is not None and h.n:
+                phases[name] = h.summary() | {"p999": h.quantile(0.999)}
+        doc = {
+            "sampling": {"rate": self.rate, "seed": self.seed,
+                         "counts": dict(self.counts),
+                         "in_flight": self._live},
+            "slo": {
+                "target_s": self.slo_s,
+                "e2e_p999_s": metrics._gauges.get("lat_e2e_p999_s", 0.0),
+                "burn_ratio": metrics._gauges.get("lat_slo_burn_ratio",
+                                                  0.0),
+            },
+            "phases": phases,
+            "recent": [sp.to_dict() for sp in list(self.recent)],
+        }
+        for key in ("lat_e2e_s", "lat_read_e2e_s"):
+            h = metrics._histograms.get(key)
+            if h is not None and h.n:
+                doc[key[:-2]] = h.summary() | {"p999": h.quantile(0.999)}
+        return doc
+
+
+def tracer_from_env(seed: int = 0, slo_s: float = 0.5,
+                    default_rate: int = 64) -> Optional[LatencyTracer]:
+    """Build the node's tracer from RAFT_LAT_SAMPLE (1/N sampling;
+    0/negative disables — the node then holds None and every hot-path
+    hook is one is-None check)."""
+    import os
+    raw = os.environ.get("RAFT_LAT_SAMPLE", "").strip()
+    try:
+        rate = int(raw) if raw else default_rate
+    except ValueError:
+        rate = default_rate
+    if rate <= 0:
+        return None
+    return LatencyTracer(rate, seed=seed, slo_s=slo_s)

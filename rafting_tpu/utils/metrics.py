@@ -49,7 +49,23 @@ def escape_label_value(v: str) -> str:
 
 
 class Histogram:
-    """Fixed log-spaced buckets (microseconds to minutes by default)."""
+    """Fixed log-spaced buckets (microseconds to minutes by default).
+
+    Thread contract — SINGLE WRITER, many readers.  ``observe`` (and
+    ``reset``/``merge``) must only be called from one thread at a time;
+    in the node runtime that is the tick thread: the striped host tier's
+    W workers return their stage timings through the phase barrier and
+    the tick thread observes the per-tick max (runtime/node.py striped
+    phase), and the latency tracer's client-thread samples park in
+    per-thread rings that the tick thread drains in ``harvest``
+    (utils/latency.py).  Concurrent ``observe`` from two threads would
+    lose increments (``counts[i] += 1`` is a read-modify-write) — grow a
+    per-worker shard and fold it with ``merge`` instead.  Readers
+    (HTTP scrape threads calling ``summary``/``quantile``/
+    ``render_prometheus``) may race the writer freely: they take an
+    atomic ``list(counts)`` snapshot and derive the sample count from
+    its sum, so bucket series stay monotone even mid-observe.  The test
+    suite enforces both halves (tests/test_latency.py)."""
 
     def __init__(self, bounds: Optional[List[float]] = None):
         if bounds is None:
@@ -77,24 +93,51 @@ class Histogram:
         self.n = 0
         self.max = 0.0
 
-    def quantile(self, q: float) -> float:
-        """Upper bucket bound at quantile q (conservative estimate)."""
-        if self.n == 0:
+    def merge(self, other: "Histogram") -> None:
+        """Fold another histogram's samples into this one (writer-side
+        only — same single-writer contract as ``observe``).  Bounds must
+        match; this is the shard-fold primitive for any future
+        per-worker histogram sharding."""
+        if other.bounds != self.bounds:
+            raise ValueError("histogram bounds mismatch")
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.total += other.total
+        self.n += other.n
+        if other.max > self.max:
+            self.max = other.max
+
+    def quantile(self, q: float, _counts: Optional[List[int]] = None
+                 ) -> float:
+        """Upper bucket bound at quantile q (conservative estimate).
+        Safe to call from reader threads: operates on an atomic snapshot
+        of the counts (``_counts`` lets ``summary`` reuse one snapshot
+        for all three quantiles)."""
+        counts = list(self.counts) if _counts is None else _counts
+        n = sum(counts)
+        if n == 0:
             return 0.0
-        target = q * self.n
+        target = q * n
         seen = 0
-        for i, c in enumerate(self.counts):
+        for i, c in enumerate(counts):
             seen += c
             if seen >= target:
                 return self.bounds[i] if i < len(self.bounds) else self.max
         return self.max
 
     def summary(self) -> dict:
+        # One atomic counts snapshot serves count and every quantile, so
+        # a scrape racing the writer reports an internally consistent
+        # row; mean pairs it with a total read just after (the skew is
+        # at most the samples observed in between — harmless for a
+        # monitoring mean, and never a crash or negative value).
+        counts = list(self.counts)
+        n = sum(counts)
         return {
-            "count": self.n,
-            "mean": self.total / self.n if self.n else 0.0,
-            "p50": self.quantile(0.5),
-            "p99": self.quantile(0.99),
+            "count": n,
+            "mean": self.total / n if n else 0.0,
+            "p50": self.quantile(0.5, counts),
+            "p99": self.quantile(0.99, counts),
             "max": self.max,
         }
 
@@ -141,7 +184,11 @@ class Metrics:
         long-lived node's ``rates(since_last=True)`` then reports CURRENT
         throughput over the window since this call, not a lifetime
         average diluted by hours of history (the benchmark checkpoints at
-        the start of its measure phase)."""
+        the start of its measure phase).  Race note: ``dict(d)`` is one
+        atomic C call under the GIL, so a checkpoint racing the tick
+        thread's counter bumps captures a point-in-time copy; the window
+        between the copy and ``monotonic()`` only skews the first
+        windowed rate by nanoseconds."""
         self._ckpt_counters = dict(self._counters)
         self._ckpt_t = time.monotonic()
 
@@ -211,13 +258,19 @@ class Metrics:
             h = histograms[name]
             m = _prom_name(name, prefix)
             lines.append(f"# TYPE {m} histogram")
+            # Atomic counts snapshot with _count derived from its sum:
+            # reading the live list while the tick thread observes could
+            # render cum > h.n (read at a different instant), a
+            # non-monotone bucket series scrapers reject.
+            counts = list(h.counts)
+            n = sum(counts)
             cum = 0
-            for bound, c in zip(h.bounds, h.counts):
+            for bound, c in zip(h.bounds, counts):
                 cum += c
                 lines.append(f'{m}_bucket{{le="{bound:.6g}"}} {cum}')
-            lines.append(f'{m}_bucket{{le="+Inf"}} {h.n}')
+            lines.append(f'{m}_bucket{{le="+Inf"}} {n}')
             lines.append(f"{m}_sum {_prom_value(h.total)}")
-            lines.append(f"{m}_count {h.n}")
+            lines.append(f"{m}_count {n}")
         return "\n".join(lines) + "\n"
 
 

@@ -13,6 +13,14 @@ Usage:
 With ``--group`` omitted, every group with events is printed.  ``--node``
 selects the node axis of a stacked [N, G, D] cluster dump (default 0).
 ``--json`` emits machine-readable output instead of the table.
+
+Dumps saved with ``meta={"latency": node.latency_snapshot()}`` also
+carry the PR 13 latency plane: sampled lifecycle spans interleave with
+the group's flight-recorder events on the shared tick axis (a span
+prints after the last event at or before its accept tick), and the
+striped host tier's per-worker utilization intervals print per tick.
+Use tools/latency_report.py for the percentile/SLO view of the same
+snapshot.
 """
 
 import argparse
@@ -37,6 +45,14 @@ def _load_tracelog():
     return mod
 
 
+def _print_span(sp: dict) -> None:
+    phases = " ".join(f"{k}={v * 1e3:.3f}ms"
+                      for k, v in (sp.get("phases") or {}).items())
+    print(f"  span  tick {sp.get('tick', -1):<8d} seq {sp.get('seq')} "
+          f"{sp.get('kind')} idx={sp.get('idx')} "
+          f"[{sp.get('outcome')}] {phases}")
+
+
 def main(argv=None) -> int:
     tracelog = _load_tracelog()
     decode_group, load_dump = tracelog.decode_group, tracelog.load_dump
@@ -52,6 +68,17 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     lanes = load_dump(args.dump)
+    # Latency-plane meta (optional): sampled spans + per-worker
+    # utilization ride the artifact's _meta lane, which load_dump's
+    # typed-lane view drops — read the raw JSON for it.
+    with open(args.dump) as f:
+        meta = json.load(f).get("_meta") or {}
+    lat = meta.get("latency") or {}
+    spans_by_g = {}
+    for sp in lat.get("recent") or []:
+        spans_by_g.setdefault(sp.get("group", -1), []).append(sp)
+    util_by_tick = {u.get("tick"): u.get("workers") or []
+                    for u in lat.get("worker_util") or []}
     stacked = lanes["n"].ndim == 2
     counts = lanes["n"][args.node] if stacked else lanes["n"]
     groups = ([args.group] if args.group is not None
@@ -62,20 +89,35 @@ def main(argv=None) -> int:
         events, dropped = decode_group(
             lanes, g, node=args.node if stacked else None)
         out.append({"group": g, "events": events, "dropped": dropped,
-                    "total": int(counts[g])})
+                    "total": int(counts[g]), "spans": spans_by_g.get(g, [])})
     try:
         if args.as_json:
-            print(json.dumps(out))
+            print(json.dumps({"groups": out,
+                              "worker_util": lat.get("worker_util") or []}))
             return 0
         for doc in out:
             head = (f"group {doc['group']}: {doc['total']} events"
                     + (f" ({doc['dropped']} overwritten before this window)"
                        if doc["dropped"] else ""))
             print(head)
+            # Interleave sampled spans on the shared tick axis: a span
+            # prints after the last event at or before its accept tick.
+            spans = sorted(doc["spans"], key=lambda s: s.get("tick", -1))
+            si = 0
             for ev in doc["events"]:
+                while si < len(spans) \
+                        and spans[si].get("tick", -1) <= ev["tick"]:
+                    _print_span(spans[si])
+                    si += 1
                 print(f"  #{ev['seq']:<5d} tick {ev['tick']:<8d} "
                       f"term {ev['term']:<6d} {ev['event']:<22s} "
                       f"aux={tracelog.format_aux(ev['kind'], ev['aux'])}")
+                util = util_by_tick.pop(ev["tick"], None)
+                if util is not None:
+                    print(f"         tick {ev['tick']:<8d} workers "
+                          f"[stage,fsync,send,apply]s: {util}")
+            for sp in spans[si:]:
+                _print_span(sp)
         if not out:
             print("no events recorded")
     except BrokenPipeError:   # `... | head` is the normal workflow
