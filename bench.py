@@ -469,6 +469,146 @@ def run_member_ladder(profile_unused: str = "") -> None:
         sys.exit(1)
 
 
+def run_openloop_stage() -> None:
+    """BENCH_OPENLOOP=1: the overload stage replaces the ladder — an
+    OPEN-LOOP rate sweep (testkit/openloop.py) against a small durable
+    3-node cluster, with the admission-control plane ON and then
+    force-disabled (RAFT_ADMISSION=0), emitting offered-vs-goodput +
+    shed-rate + admitted-percentile curves per sweep point.  The
+    headline is the NO-COLLAPSE property: past the measured capacity,
+    goodput with admission on plateaus (>= 85% of its peak) and the
+    admitted p999 stays bounded, while the admission-off control run is
+    free to collapse (unbounded standing queues -> every completion
+    lands past its deadline).  Closed-loop ladders cannot see any of
+    this — the driver's politeness hides the overload (ROADMAP item 5).
+
+    Scale knobs: BENCH_OPENLOOP_GROUPS (default 8), BENCH_OPENLOOP_DUR
+    (seconds per sweep point, default 2), BENCH_OPENLOOP_MULTS (offered
+    load as x capacity, default "0.5,1.0,2.0,3.0")."""
+    import shutil
+    import tempfile
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from rafting_tpu.core.types import EngineConfig
+    from rafting_tpu.testkit.harness import LocalCluster
+    from rafting_tpu.testkit.openloop import (
+        OpenLoopSpec, no_collapse_check, run_open_loop)
+
+    n_groups = int(os.environ.get("BENCH_OPENLOOP_GROUPS", "8"))
+    dur = float(os.environ.get("BENCH_OPENLOOP_DUR", "2"))
+    mults = [float(x) for x in os.environ.get(
+        "BENCH_OPENLOOP_MULTS", "0.5,1.0,2.0,3.0").split(",")]
+    deadline_s = float(os.environ.get("BENCH_OPENLOOP_DEADLINE_S", "1.0"))
+    cfg = EngineConfig(
+        n_groups=n_groups, n_peers=3, log_slots=64, batch=8, max_submit=8,
+        election_ticks=10, heartbeat_ticks=3, rpc_timeout_ticks=8)
+
+    def build(root: str) -> LocalCluster:
+        c = LocalCluster(cfg, root, seed=7)
+        for g in range(n_groups):
+            c.wait_leader(g)
+        return c
+
+    def submit_fn(c: LocalCluster):
+        leaders = {g: c.leader_of(g) for g in range(n_groups)}
+
+        def submit(grp: int, tenant: str, seq: int):
+            g = grp % n_groups
+            ld = leaders.get(g)
+            if ld is None or not c.nodes[ld].is_leader(g):
+                leaders[g] = ld = c.leader_of(g)
+            if ld is None:
+                return None
+            return c.nodes[ld].submit(g, b"ol-%d" % seq, tenant=tenant)
+        return submit
+
+    def probe_capacity(c: LocalCluster) -> float:
+        """Closed-loop throughput at this scale: burst-submit to every
+        leader, tick until drained, repeat — the politeness the open
+        loop then discards."""
+        t0 = time.monotonic()
+        done = 0
+        for _ in range(16):
+            futs = []
+            for g in range(n_groups):
+                ld = c.leader_of(g)
+                if ld is not None:
+                    futs.append(c.nodes[ld].submit_batch(
+                        g, [b"cap"] * 8))
+            for _ in range(200):
+                if all(f.done() for f in futs):
+                    break
+                c.tick(1)
+            done += sum(8 for f in futs
+                        if f.done() and f.exception() is None)
+        return done / max(time.monotonic() - t0, 1e-9)
+
+    def sweep(c: LocalCluster, cap: float, label: str) -> list:
+        out = []
+        for m in mults:
+            spec = OpenLoopSpec(
+                rate=max(1.0, cap * m), duration_s=dur, n_tenants=4,
+                n_groups=n_groups, deadline_s=deadline_s,
+                seed=int(m * 100))
+            r = run_open_loop(spec, submit_fn(c),
+                              step=lambda: c.tick(1), drain_s=2.0)
+            d = r.to_dict()
+            d["offered_x_capacity"] = m
+            adms = [n.admission for n in c.nodes.values()]
+            d["admission"] = {
+                "enabled": adms[0].enabled,
+                "level": round(max(a.level for a in adms), 4),
+                "shed_total": sum(a.shed for a in adms)}
+            out.append((m, r, d))
+            emit({"metric": f"open-loop goodput @{n_groups} groups, "
+                            f"admission={label}, offered={m:g}x capacity",
+                  "value": round(r.goodput, 1), "unit": "ops/sec",
+                  "vs_baseline": None, **d})
+        return out
+
+    results = {}
+    for label, env_admission in (("on", None), ("off", "0")):
+        root = tempfile.mkdtemp(prefix=f"openloop-{label}-")
+        old = os.environ.get("RAFT_ADMISSION")
+        try:
+            if env_admission is not None:
+                os.environ["RAFT_ADMISSION"] = env_admission
+            else:
+                os.environ.pop("RAFT_ADMISSION", None)
+            c = build(root)
+            try:
+                cap = probe_capacity(c)
+                emit({"metric": f"closed-loop capacity probe "
+                                f"@{n_groups} groups (admission={label})",
+                      "value": round(cap, 1), "unit": "ops/sec",
+                      "vs_baseline": None})
+                results[label] = (cap, sweep(c, cap, label))
+            finally:
+                c.close()
+        finally:
+            if old is None:
+                os.environ.pop("RAFT_ADMISSION", None)
+            else:
+                os.environ["RAFT_ADMISSION"] = old
+            shutil.rmtree(root, ignore_errors=True)
+
+    on = [r for _m, r, _d in results["on"][1]]
+    ok, why = no_collapse_check(on, slo_s=deadline_s)
+    emit({"metric": "open-loop no-collapse verdict (admission on)",
+          "value": 1 if ok else 0, "unit": "pass", "vs_baseline": None,
+          "why": why,
+          "capacity_ops_per_sec": round(results["on"][0], 1)})
+    save_artifact(
+        {"platform": "cpu", "scale": n_groups,
+         "capacity": {k: round(v[0], 1) for k, v in results.items()},
+         "sweep": {k: [d for _m, _r, d in v[1]]
+                   for k, v in results.items()},
+         "no_collapse": {"ok": ok, "why": why}},
+        note="BENCH_OPENLOOP stage: open-loop overload sweep")
+    assert ok, f"no-collapse property failed: {why}"
+
+
 def run_latency_ab() -> None:
     """BENCH_LAT=1: the latency-plane overhead A/B replaces the ladder —
     durable commits/sec through bench_runtime.run() with span sampling
@@ -695,6 +835,11 @@ def main() -> None:
         # The latency-plane overhead A/B replaces the ladder: durable
         # commits/sec with 1/64 span sampling vs off (<2% budget).
         run_latency_ab()
+        return
+    if env_flag("BENCH_OPENLOOP"):
+        # The overload stage replaces the ladder: open-loop rate sweep
+        # with admission control on vs force-disabled (no-collapse A/B).
+        run_openloop_stage()
         return
 
     profile_dir = os.environ.get("BENCH_PROFILE_DIR", "")

@@ -4,9 +4,10 @@ support/RaftFactory.java / support/RaftConfig.java)."""
 
 from .anomaly import (
     BusyLoopError, NotLeaderError, NotReadyError, ObsoleteContextError,
-    RaftError, RetryCommandError, SerializeError, StorageFaultError,
-    WaitTimeoutError,
+    OverloadError, RaftError, RetryCommandError, SerializeError,
+    StorageFaultError, UnavailableError, WaitTimeoutError, retry_after_of,
 )
+from .retry import CircuitBreaker, RetryBudget
 from .config import RaftConfig, load_xml_config
 from .container import ADMIN_GROUP, GroupRegistry, RaftContainer
 from .factory import RaftFactory
@@ -18,6 +19,8 @@ __all__ = [
     "RaftStub", "GroupRegistry", "ADMIN_GROUP",
     "CmdSerializer", "JsonSerializer", "RawSerializer",
     "RaftError", "NotLeaderError", "NotReadyError", "BusyLoopError",
-    "ObsoleteContextError", "WaitTimeoutError", "RetryCommandError",
-    "SerializeError", "StorageFaultError",
+    "OverloadError", "UnavailableError", "ObsoleteContextError",
+    "WaitTimeoutError", "RetryCommandError", "SerializeError",
+    "StorageFaultError", "retry_after_of",
+    "RetryBudget", "CircuitBreaker",
 ]

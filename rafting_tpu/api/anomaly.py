@@ -9,11 +9,30 @@ the *taxonomy* is what's preserved.
 
 from __future__ import annotations
 
+import re
 from typing import Optional
 
 
 class RaftError(Exception):
     """Base for all framework errors (reference RaftException)."""
+
+
+# Retry-after hints travel inside the refusal MESSAGE (the forward wire
+# carries only "REFUSED:TypeName: msg", transport/codec.py serve_forward),
+# so the hint survives the relay without a codec change.
+_RETRY_AFTER = re.compile(r"\[retry_after=([0-9.]+)s\]")
+
+
+def retry_after_of(exc_or_msg) -> Optional[float]:
+    """Extract a server-issued retry-after hint (seconds) from a refusal:
+    the typed attribute when present, else the wire-format marker embedded
+    in the message.  None = no hint (caller falls back to its own
+    backoff)."""
+    ra = getattr(exc_or_msg, "retry_after_s", None)
+    if ra is not None:
+        return float(ra)
+    m = _RETRY_AFTER.search(str(exc_or_msg))
+    return float(m.group(1)) if m else None
 
 
 def as_refusal(exc: RaftError) -> RaftError:
@@ -53,8 +72,35 @@ class NotReadyError(RaftError):
 
 
 class BusyLoopError(RaftError):
-    """Backpressure: the node's submission queue for the group is full
-    (reference BusyLoopException, support/EventLoop.java:136-138)."""
+    """Backpressure: the node's submission queue for the group is full,
+    or storage backpressure (ENOSPC) paused admission (reference
+    BusyLoopException, support/EventLoop.java:136-138).
+
+    ``retry_after_s`` (optional) is the server's hint for how long the
+    client should back off before retrying THIS node; it is embedded in
+    the message so it survives the forward relay (``retry_after_of``
+    parses it back out on the far side)."""
+
+    def __init__(self, msg: str = "", retry_after_s: Optional[float] = None):
+        if retry_after_s is not None and "[retry_after=" not in msg:
+            # Not re-embedded when the marker already rides the message
+            # (a wire_refusal rebuild re-parsing its own detail text).
+            msg = f"{msg} [retry_after={float(retry_after_s):.3f}s]"
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class OverloadError(BusyLoopError):
+    """Admission control shed this request: the node's offer queues have
+    a standing delay above the CoDel-style target and the controller is
+    load-shedding to keep admitted-request latency bounded
+    (runtime/admission.py; beyond-reference — the reference's only
+    admission story is Netty's unbounded channel queue).
+
+    Always a MARKED pre-log refusal: the command never entered any
+    queue, so retrying elsewhere — or here, after ``retry_after_s`` —
+    can never double-apply.  Subclasses BusyLoopError so existing
+    backpressure handlers treat shedding and queue-full uniformly."""
 
 
 class StorageFaultError(RaftError):
@@ -70,6 +116,20 @@ class StorageFaultError(RaftError):
     replicated before the fault, so the outcome is unknown (the same
     ambiguity BatchAbortedError documents).  Recovery: retry against the
     peer that wins the ensuing election."""
+
+
+class UnavailableError(StorageFaultError):
+    """This node cannot serve the group AT ALL right now — its WAL stripe
+    is fail-stop quarantined (the lane is going silent so a healthy
+    replica takes over).  Always a typed, immediate, MARKED pre-log
+    refusal: fresh submits and reads targeting a quarantined stripe
+    fast-fail with this instead of riding a future to its full timeout.
+    Retrying against THIS node is futile until an operator replaces the
+    disk; retry against the peer that wins the ensuing election.
+    Subclasses StorageFaultError so storage-aware handlers keep working;
+    the distinct type lets clients (and the stub's circuit breaker)
+    route around the node instead of waiting out the ambiguity that
+    plain StorageFaultError (outcome unknown) implies."""
 
 
 class ObsoleteContextError(RaftError):
@@ -119,3 +179,30 @@ class BatchAbortedError(RaftError):
         self.cause = cause
         self.results = results
         self.completed = completed
+
+
+def wire_refusal(kind: str, detail: str) -> RaftError:
+    """Rebuild a typed, MARKED refusal from the forward wire's
+    ``REFUSED:TypeName: detail`` reply (transport/codec.py serve_forward)
+    so the relay preserves the taxonomy end to end — retry-after hints
+    included (they ride the detail text; the typed constructors re-parse
+    them so ``retry_after_s`` is set on the rebuilt exception too).
+    Unknown kinds come back as a marked bare RaftError (still refusal-
+    marked: the serve side only stamps REFUSED on provably-pre-log
+    failures)."""
+    ra = retry_after_of(detail)
+    if kind == "BusyLoopError":
+        exc: RaftError = BusyLoopError(detail, retry_after_s=ra)
+    elif kind == "OverloadError":
+        exc = OverloadError(detail, retry_after_s=ra)
+    elif kind == "NotReadyError":
+        exc = NotReadyError(detail)
+    elif kind == "UnavailableError":
+        exc = UnavailableError(detail)
+    elif kind == "StorageFaultError":
+        exc = StorageFaultError(detail)
+    elif kind == "ObsoleteContextError":
+        exc = ObsoleteContextError(detail)
+    else:
+        exc = RaftError(detail)
+    return as_refusal(exc)

@@ -14,14 +14,18 @@ from concurrent.futures import Future, TimeoutError as _FutTimeout
 from typing import Any, Optional, Union
 
 from .anomaly import (
-    NotLeaderError, ObsoleteContextError, RaftError, WaitTimeoutError,
-    is_refusal,
+    NotLeaderError, ObsoleteContextError, OverloadError, RaftError,
+    WaitTimeoutError, as_refusal, is_refusal, retry_after_of, wire_refusal,
 )
+from .retry import BreakerBoard, CircuitBreaker, RetryBudget
 
 
 class RaftStub:
     def __init__(self, container, name: str, lane: int, forward: bool = True,
-                 forward_budget: float = 20.0, max_redirects: int = 16):
+                 forward_budget: float = 20.0, max_redirects: int = 16,
+                 tenant: Optional[str] = None,
+                 retry_budget: Optional[RetryBudget] = None,
+                 breakers: Optional[BreakerBoard] = None):
         """``forward=True`` relays submissions to the current leader over
         the transport when this node is a follower, instead of bouncing
         NotLeader back to the caller (the reference only returns the hint,
@@ -44,14 +48,46 @@ class RaftStub:
         the whole budget doing it.  After this many redirects the last
         refusal surfaces to the caller even with budget left.  Retries
         back off exponentially with +/-50% jitter (decorrelating the
-        thundering herd of callers all chasing the same election)."""
+        thundering herd of callers all chasing the same election), or by
+        the server's explicit retry-after hint when the refusal carries
+        one (OverloadError / BusyLoopError, api/anomaly.py).
+
+        Self-protection (the client half of the overload-control plane,
+        ISSUE 15): ``tenant`` labels this stub's traffic for the server's
+        per-tenant fair shedding; ``retry_budget`` is the token bucket
+        capping refusal-driven retries at ~10% of fresh traffic (shared
+        container-wide by default — retry pressure is a process-level
+        property); ``breakers`` is the per-peer circuit-breaker board
+        (also container-shared: a dead peer is dead for every stub).
+        When the budget is spent or a peer's breaker is open, refusals
+        surface to the caller immediately instead of amplifying the
+        overload they report (api/retry.py)."""
         self._container = container
         self.name = name
         self._lane = lane
         self.forward = forward
         self.forward_budget = forward_budget
         self.max_redirects = max_redirects
+        self.tenant = tenant
+        self._budget = retry_budget if retry_budget is not None \
+            else self._shared(container, "_retry_budget", RetryBudget)
+        self._breakers = breakers if breakers is not None \
+            else self._shared(container, "_breaker_board", BreakerBoard)
         self._closed = False
+
+    @staticmethod
+    def _shared(container, attr: str, factory):
+        """Container-wide singleton (budget / breaker board).  A create
+        race between two stubs is benign — one instance wins, the loser
+        was never observed."""
+        obj = getattr(container, attr, None)
+        if obj is None:
+            try:
+                obj = factory()
+                setattr(container, attr, obj)
+            except AttributeError:   # container with __slots__ / frozen
+                return factory()
+        return obj
 
     @property
     def lane(self) -> int:
@@ -81,8 +117,9 @@ class RaftStub:
             raise ObsoleteContextError(f"stub for {self.name!r} closed")
         node = self._container._node
         payload = node.serializer.encode_command(command)
+        self._budget.deposit()   # fresh traffic funds future retries
         if node.is_leader(self.lane) or not self.forward:
-            fut = node.submit(self.lane, payload)
+            fut = node.submit(self.lane, payload, tenant=self.tenant)
             # A MARKED refusal provably never entered the log, so retrying
             # through the forward path is safe for every TRANSIENT kind —
             # NotLeader (leadership moved between our check and the
@@ -114,8 +151,9 @@ class RaftStub:
             raise ObsoleteContextError(f"stub for {self.name!r} closed")
         node = self._container._node
         payload = node.serializer.encode_command(query)
+        self._budget.deposit()
         if node.is_leader(self.lane) or not self.forward:
-            fut = node.read(self.lane, payload)
+            fut = node.read(self.lane, payload, tenant=self.tenant)
             exc = fut.exception() if fut.done() else None
             if (self.forward and exc is not None and is_refusal(exc)
                     and type(exc).__name__ in self._TRANSIENT_REFUSALS):
@@ -136,7 +174,9 @@ class RaftStub:
             raise ObsoleteContextError(f"stub for {self.name!r} closed")
         node = self._container._node
         enc = node.serializer.encode_command
-        return node.read_batch(self.lane, [enc(q) for q in queries])
+        self._budget.deposit(len(queries))
+        return node.read_batch(self.lane, [enc(q) for q in queries],
+                               tenant=self.tenant)
 
     def execute_read(self, query: Union[bytes, str],
                      timeout: Optional[float] = None) -> Any:
@@ -166,9 +206,18 @@ class RaftStub:
     # Remote refusals carry the marker as the serve side's REFUSED: wire
     # prefix.  Among refusals, only these TYPES are transient enough to
     # retry — an ObsoleteContextError (group destroyed) is a refusal too,
-    # but retrying it for the whole budget is futile.
+    # but retrying it for the whole budget is futile.  OverloadError
+    # (admission shed) and UnavailableError (quarantined stripe) are
+    # transient FROM THE CLUSTER'S view — the shed clears / a healthy
+    # replica takes over — but both count against the peer's circuit
+    # breaker so a persistently refusing node gets routed around.
     _TRANSIENT_REFUSALS = ("NotLeaderError", "NotReadyError",
-                           "BusyLoopError")
+                           "BusyLoopError", "OverloadError",
+                           "UnavailableError")
+    # Refusal kinds that mean the PEER is sick (breaker ``failure()``),
+    # as opposed to healthy routing chatter (NotLeader/NotReady).
+    _PEER_SICK = ("BusyLoopError", "OverloadError", "UnavailableError",
+                  "StorageFaultError")
 
     def _forwarded(self, payload: bytes,
                    budget: Optional[float] = None,
@@ -207,19 +256,39 @@ class RaftStub:
 
             def backoff(last_refusal: Exception) -> None:
                 # Count + sleep for ONE refusal-driven retry.  Raises the
-                # refusal once either bound trips; jittered exponential
-                # sleep otherwise (0.05s doubling, capped at 0.5s).
+                # refusal once any bound trips: redirect cap, wall
+                # deadline, or the shared RETRY BUDGET — a drained bucket
+                # means the fleet is already refusing at scale, and the
+                # anti-amplification move is to surface the refusal NOW
+                # rather than add retry load (api/retry.py).  Sleep
+                # honors the server's retry-after hint when the refusal
+                # carries one (jittered UP only — retrying before the
+                # server's window cannot see a different decision), else
+                # jittered exponential (0.05s doubling, capped at 0.5s).
                 nonlocal retries
                 retries += 1
                 if retries > self.max_redirects:
                     raise last_refusal
                 if _time.monotonic() >= overall:
                     raise last_refusal
-                _time.sleep(min(0.5, 0.05 * (2 ** min(retries, 4)))
-                            * random.uniform(0.5, 1.5))
+                if not self._budget.try_spend():
+                    raise last_refusal
+                ra = retry_after_of(last_refusal)
+                if ra is not None and ra > 0:
+                    delay = ra * random.uniform(1.0, 1.5)
+                else:
+                    delay = (min(0.5, 0.05 * (2 ** min(retries, 4)))
+                             * random.uniform(0.5, 1.5))
+                _time.sleep(min(delay, left()))
 
             try:
-                local_op = node.read if read else node.submit
+                tenant = self.tenant
+                if read:
+                    def local_op(g, p):
+                        return node.read(g, p, tenant=tenant)
+                else:
+                    def local_op(g, p):
+                        return node.submit(g, p, tenant=tenant)
                 remote_op = (node.transport.forward_read if read
                              else node.transport.forward_submit)
                 while True:
@@ -264,23 +333,49 @@ class RaftStub:
                         if hint is not None and hint != node.node_id:
                             break
                         backoff(NotLeaderError(lane, None))
-                    ok, raw = remote_op(hint, self.lane, payload,
-                                        timeout=left())
+                    br = self._breakers.get(hint)
+                    if not br.allow():
+                        # Circuit open: don't even connect.  Back off by
+                        # the breaker's own cooldown hint, then re-resolve
+                        # the target — leadership may have moved off the
+                        # sick peer in the meantime.
+                        backoff(as_refusal(OverloadError(
+                            f"peer {hint}: circuit open",
+                            retry_after_s=br.retry_after_s())))
+                        continue
+                    try:
+                        ok, raw = remote_op(hint, self.lane, payload,
+                                            timeout=left())
+                    except Exception:
+                        br.failure()   # transport error: peer unreachable
+                        raise
                     if ok:
+                        br.success()
                         out.set_result(node.serializer.decode_result(raw))
                         return
                     msg = raw.decode(errors="replace")
-                    kind = msg.split(":", 2)[1] if ":" in msg else ""
-                    if (msg.startswith("REFUSED:")
-                            and kind in self._TRANSIENT_REFUSALS):
-                        backoff(NotLeaderError(lane, hint)
-                                if kind == "NotLeaderError"
-                                else RaftError(msg))
-                        continue
-                    if msg.startswith("REFUSED:ObsoleteContextError"):
-                        # Permanent refusal: surface the right type
+                    parts = msg.split(":", 2)
+                    kind = parts[1] if len(parts) > 1 else ""
+                    detail = parts[2] if len(parts) > 2 else msg
+                    if msg.startswith("REFUSED:"):
+                        # The peer answered: overload / storage refusals
+                        # count against its breaker, routing chatter
+                        # (NotLeader/NotReady) proves it healthy.
+                        if kind in self._PEER_SICK:
+                            br.failure()
+                        else:
+                            br.success()
+                        exc = (NotLeaderError(lane, hint)
+                               if kind == "NotLeaderError"
+                               else wire_refusal(kind, detail))
+                        if kind in self._TRANSIENT_REFUSALS:
+                            backoff(exc)
+                            continue
+                        # Permanent refusal (ObsoleteContext, plain
+                        # StorageFault): surface the rebuilt TYPE
                         # immediately, matching the local-submit branch.
-                        raise ObsoleteContextError(msg.split(":", 2)[2])
+                        raise exc
+                    br.failure()
                     raise RaftError(f"forward failed: {msg}")
             except Exception as e:
                 if not out.done():

@@ -61,8 +61,10 @@ from ..transport.codec import (
 )
 from ..api.anomaly import (
     BatchAbortedError, BusyLoopError, NotLeaderError, NotReadyError,
-    ObsoleteContextError, StorageFaultError, as_refusal,
+    ObsoleteContextError, OverloadError, StorageFaultError,
+    UnavailableError, as_refusal,
 )
+from .admission import admission_from_env
 from ..log.wal import WalNoSpace, WalSyncError
 from ..utils.latency import (
     ACKED, FSYNCED, OFFERED, SENT, SERVED, STAGED, tracer_from_env,
@@ -191,12 +193,16 @@ class _SubBatch:
     submit_batch), so the tick thread's accept path is pure pointer
     arithmetic — no per-entry Python ever again."""
 
-    __slots__ = ("run", "sink", "taken")
+    __slots__ = ("run", "sink", "taken", "t_enq")
 
     def __init__(self, run, sink: BatchSubmit):
         self.run = run          # codec.PayloadRun (start unused: 0)
         self.sink = sink
         self.taken = 0
+        # Enqueue instant — the sojourn clock the admission controller's
+        # queue-delay signal reads at device-accept time (runtime/
+        # admission.py).
+        self.t_enq = time.monotonic()
 
 
 class _ReadBatch:
@@ -458,6 +464,15 @@ class RaftNode:
         self.group_queue_cap = group_queue_cap
         self.total_queue_cap = total_queue_cap
         self.busy_threshold = busy_threshold   # free slots -> BusyLoopError
+        # Admission control (runtime/admission.py): CoDel-style queue-
+        # delay policy over the offer queues.  The hard caps above are
+        # correctness backstops; the controller sheds BEFORE they fill,
+        # keeping admitted-request latency bounded under open-loop
+        # overload.  RAFT_ADMISSION=0 disables (admit() then always
+        # passes and only the caps remain).
+        self.admission = admission_from_env(seed=seed ^ node_id)
+        self._adm_delay: Optional[float] = None  # this tick's sojourn sample
+        self._adm_fold = [0, 0, 0, 0]  # counters folded into metrics
 
         # Linearizable read plane (ReadIndex + lease, core/step.py phase
         # 8b): the host-side FIFO mirror of the device's rq_* lanes.  A
@@ -631,6 +646,13 @@ class RaftNode:
         self.metrics.gauge("stripes_poisoned", 0)
         self.metrics.gauge("io_backpressure", 0)
         self.metrics.gauge("io_slow", 0)
+        # Admission-control plane: counters render at 0 from boot; the
+        # level gauge tracks the controller's shed probability.
+        for _c in ("admission_admitted", "admission_shed",
+                   "admission_shed_tenant", "admission_expired"):
+            self.metrics[_c] += 0
+        self.metrics.gauge("admission_level", 0.0)
+        self.metrics.gauge("admission_shedding", 0)
         # The transport reports its own health (reconnects_total) into
         # the node registry; set before start() spawns sender threads.
         self.transport.metrics = self.metrics
@@ -803,7 +825,8 @@ class RaftNode:
         self._fold_wal_stats()   # final engine-counter fold (short runs
         self.store.close()       # never reach a 32-tick maintain pass)
 
-    def submit(self, group: int, payload: bytes) -> Future:
+    def submit(self, group: int, payload: bytes,
+               tenant: Optional[str] = None) -> Future:
         """Offer a command to the group's replicated log.  The returned
         future completes with the machine's apply result (reference
         RaftStub.submit -> Promise, command/RaftStub.java:65-74).
@@ -832,16 +855,33 @@ class RaftNode:
         if err is not None:
             fut.set_exception(err)
             return fut
+        adm = self.admission
+        ra = adm.admit(1, tenant)
+        if ra is not None:
+            fut.set_exception(as_refusal(OverloadError(
+                f"group {group}: admission shed (overload)",
+                retry_after_s=ra)))
+            return fut
         run = PayloadRun.single(0, payload)
         with self._submit_lock:
             if (int(self._queued_n[group]) >= self.group_queue_cap
                     or self._queued_total
                     >= self.total_queue_cap - self.busy_threshold):
                 fut.set_exception(as_refusal(BusyLoopError(
-                    f"group {group}: submission queue full")))
+                    f"group {group}: submission queue full",
+                    retry_after_s=adm.busy_retry_after())))
                 return fut
-            self._submissions.setdefault(group, deque()).append(
-                _SubBatch(run, sink))
+            q = self._submissions.setdefault(group, deque())
+            b = _SubBatch(run, sink)
+            # LIFO under overload (deadline-aware: the freshest request is
+            # the likeliest to still be inside its deadline).  Never ahead
+            # of a partially-consumed head — its remaining entries keep
+            # their place, all other cross-batch order is free (promise
+            # ranges are registered per pop span, not by queue position).
+            if adm.lifo_now() and q and q[0].taken == 0:
+                q.appendleft(b)
+            else:
+                q.append(b)
             self._queued_n[group] += 1
             self._queued_total += 1
             tr = self._lat
@@ -851,7 +891,8 @@ class RaftNode:
                     sink.span = tr.make_span(seq, "w", 0)
         return fut
 
-    def submit_batch(self, group: int, payloads) -> Future:
+    def submit_batch(self, group: int, payloads,
+                     tenant: Optional[str] = None) -> Future:
         """Offer many commands with ONE future resolving to the list of
         apply results (in order).  Same refusal taxonomy as :meth:`submit`,
         reported on the single future; one queue-capacity check and one
@@ -872,6 +913,13 @@ class RaftNode:
         if not payloads:
             fut.set_result([])
             return fut
+        adm = self.admission
+        ra = adm.admit(len(payloads), tenant)
+        if ra is not None:
+            fut.set_exception(as_refusal(OverloadError(
+                f"group {group}: admission shed (overload)",
+                retry_after_s=ra)))
+            return fut
         run = PayloadRun.from_payloads(0, payloads)
         with self._submit_lock:
             n = len(payloads)
@@ -879,10 +927,15 @@ class RaftNode:
                     or self._queued_total + n
                     > self.total_queue_cap - self.busy_threshold):
                 fut.set_exception(as_refusal(BusyLoopError(
-                    f"group {group}: submission queue full")))
+                    f"group {group}: submission queue full",
+                    retry_after_s=adm.busy_retry_after())))
                 return fut
-            self._submissions.setdefault(group, deque()).append(
-                _SubBatch(run, batch))
+            q = self._submissions.setdefault(group, deque())
+            b = _SubBatch(run, batch)
+            if adm.lifo_now() and q and q[0].taken == 0:  # see submit()
+                q.appendleft(b)
+            else:
+                q.append(b)
             self._queued_n[group] += n
             self._queued_total += n
             tr = self._lat
@@ -919,6 +972,7 @@ class RaftNode:
         hg, bp = self._healthy_groups, self._io_backpressure
         cap = self.group_queue_cap - n
         tr = self._lat
+        adm = self.admission
         with self._submit_lock:
             headroom = (self.total_queue_cap - self.busy_threshold
                         - self._queued_total)
@@ -927,14 +981,14 @@ class RaftNode:
                 sink = BatchSubmit(n, eager=False)
                 sinks.append(sink)
                 if hg is not None and not hg[g]:
-                    sink._refuse(as_refusal(StorageFaultError(
+                    sink._refuse(as_refusal(UnavailableError(
                         f"group {g}: WAL stripe quarantined after a "
                         f"durability failure")))
                     continue
                 if bp:
                     sink._refuse(as_refusal(BusyLoopError(
                         f"group {g}: storage backpressure (WAL out of "
-                        f"disk space)")))
+                        f"disk space)", retry_after_s=1.0)))
                     continue
                 if not active[g]:
                     sink._refuse(as_refusal(
@@ -949,9 +1003,16 @@ class RaftNode:
                     sink._refuse(as_refusal(NotReadyError(
                         f"group {g}: leader lacks a healthy majority")))
                     continue
+                ra = adm.admit(n)
+                if ra is not None:
+                    sink._refuse(as_refusal(OverloadError(
+                        f"group {g}: admission shed (overload)",
+                        retry_after_s=ra)))
+                    continue
                 if qn[g] > cap or headroom < n:
                     sink._refuse(as_refusal(BusyLoopError(
-                        f"group {g}: submission queue full")))
+                        f"group {g}: submission queue full",
+                        retry_after_s=adm.busy_retry_after())))
                     continue
                 self._submissions.setdefault(g, deque()).append(
                     _SubBatch(run, sink))
@@ -969,7 +1030,8 @@ class RaftNode:
                         sink.span = tr.make_span(seq0 + k, "w", k)
         return sinks
 
-    def read(self, group: int, payload: bytes) -> Future:
+    def read(self, group: int, payload: bytes,
+             tenant: Optional[str] = None) -> Future:
         """Linearizable read: resolve with the machine's ``read(payload)``
         result (or, for machines without the read SPI, the quorum-confirmed
         ReadIndex itself) WITHOUT appending to the log.
@@ -984,10 +1046,12 @@ class RaftNode:
         (api/anomaly.py): a read never enters any log, so retrying it
         elsewhere is always safe — unlike submit's accept-abort ambiguity.
         """
-        return self.read_batch(group, [payload], _single=True)
+        return self.read_batch(group, [payload], _single=True,
+                               tenant=tenant)
 
     def read_batch(self, group: int, payloads,
-                   _single: bool = False) -> Future:
+                   _single: bool = False,
+                   tenant: Optional[str] = None) -> Future:
         """Offer many linearizable queries as ONE read batch with one
         future resolving to the list of results in order.  The whole batch
         shares one ReadIndex barrier — the amortization the read plane
@@ -1003,10 +1067,18 @@ class RaftNode:
             fut.set_result([])
             return fut
         n = len(payloads)
+        adm = self.admission
+        ra = adm.admit(n, tenant)
+        if ra is not None:
+            fut.set_exception(as_refusal(OverloadError(
+                f"group {group}: admission shed (overload)",
+                retry_after_s=ra)))
+            return fut
         with self._read_lock:
             if int(self._read_queued_n[group]) + n > self.group_queue_cap:
                 fut.set_exception(as_refusal(BusyLoopError(
-                    f"group {group}: read queue full")))
+                    f"group {group}: read queue full",
+                    retry_after_s=adm.busy_retry_after())))
                 return fut
             self._reads_waiting.setdefault(group, deque()).append(
                 _ReadBatch(list(payloads), sink, time.monotonic()))
@@ -1029,13 +1101,17 @@ class RaftNode:
         elsewhere can never double-apply (api/anomaly.py as_refusal)."""
         if self._healthy_groups is not None \
                 and not self._healthy_groups[group]:
-            return as_refusal(StorageFaultError(
+            # Typed fast-fail (UnavailableError subclasses
+            # StorageFaultError): the lane is fail-stop silent, so the
+            # client should route around this node NOW instead of riding
+            # a future to its timeout.
+            return as_refusal(UnavailableError(
                 f"group {group}: WAL stripe quarantined after a "
                 f"durability failure — retry against the new leader"))
         if self._io_backpressure:
             return as_refusal(BusyLoopError(
                 f"group {group}: storage backpressure (WAL out of "
-                f"disk space)"))
+                f"disk space)", retry_after_s=1.0))
         if not self.h_active[group]:
             return as_refusal(ObsoleteContextError(f"group {group} closed"))
         if self.h_role[group] != LEADER:
@@ -1162,6 +1238,7 @@ class RaftNode:
                 self._host_phase(ctx)
         self.metrics.observe("tick_latency_s",
                              time.perf_counter() - _tick_t0)
+        self._admission_tick(time.perf_counter() - _tick_t0)
         if self._lat is not None:
             # Merge retired spans from every thread's ring into the
             # shared histograms — tick thread only, so the registry
@@ -1169,6 +1246,35 @@ class RaftNode:
             self._lat.harvest(self.metrics)
         self.profiler.after_tick()
         return ctx.info
+
+    def _admission_tick(self, tick_s: float) -> None:
+        """Per-tick admission-controller feed + metrics fold (tick thread
+        only — the registry's single-writer contract).  The sojourn
+        sample was stashed by this tick's ``_persist_prepare`` pop; when
+        nothing popped AND the queues are empty, 0.0 is fed (the queue
+        drained — the strongest good signal); a non-empty queue with no
+        pop carries no information (None)."""
+        adm = self.admission
+        if not adm.enabled:
+            return
+        adm.note_tick(tick_s)
+        d, self._adm_delay = self._adm_delay, None
+        if d is None and self._queued_total == 0:
+            d = 0.0
+        adm.note_delay(d)
+        if d is not None:
+            self.metrics.observe("admission_queue_delay_s", d)
+        m, folded = self.metrics, self._adm_fold
+        cur = (adm.admitted, adm.shed, adm.shed_tenant, adm.expired)
+        for i, name in enumerate(("admission_admitted", "admission_shed",
+                                  "admission_shed_tenant",
+                                  "admission_expired")):
+            delta = cur[i] - folded[i]
+            if delta:
+                m[name] += delta
+                folded[i] = cur[i]
+        m.gauge("admission_level", round(adm.level, 4))
+        m.gauge("admission_shedding", 1 if adm.overloaded else 0)
 
     # ------------------------------------------------------- tick: dispatch
 
@@ -1191,22 +1297,32 @@ class RaftNode:
         if changes:
             act = np.asarray(self.state.active).copy()
             purged = []
+            hg = self._healthy_groups
             for g, a, purge in changes:
                 act[g] = a
                 if not a:
                     # Strand nothing: queued-but-unaccepted submissions AND
                     # registered promises both fail out when a lane closes.
+                    # A QUARANTINE-driven close rejects with the typed
+                    # Unavailable refusal (queued work never reached any
+                    # log — retry-safe elsewhere); promise aborts for that
+                    # case already fired in _quarantine_stripes with the
+                    # unmarked outcome-unknown StorageFaultError.
+                    if hg is not None and not hg[g]:
+                        exc_f = lambda: UnavailableError(
+                            f"group {g}: WAL stripe quarantined after a "
+                            f"durability failure — retry against the new "
+                            f"leader")
+                    else:
+                        exc_f = lambda: ObsoleteContextError(
+                            f"group {g} closed")
                     self.dispatcher.abort_promises(
                         g, ObsoleteContextError(f"group {g} closed"))
-                    self._reject_submissions(
-                        g, ObsoleteContextError(f"group {g} closed"))
+                    self._reject_submissions(g, exc_f())
                     # Reads too — including barrier-confirmed ones: the
                     # machine they would query is going away.
-                    self._reject_reads(
-                        g, ObsoleteContextError(f"group {g} closed"),
-                        drop_released=True)
-                    self._reject_membership(
-                        g, ObsoleteContextError(f"group {g} closed"))
+                    self._reject_reads(g, exc_f(), drop_released=True)
+                    self._reject_membership(g, exc_f())
                 if purge:
                     purged.append(g)
             self.state = self.state.replace(active=jnp.asarray(act))
@@ -2026,7 +2142,16 @@ class RaftNode:
         tr = self._lat
         lat_tick = self._lat_tick
         lat_tick.clear()
-        if len(sub_groups):
+        if len(sub_groups) or self._queued_total > 0:
+            # Sojourn clock for the admission controller: device-accept
+            # time minus the OLDEST queued batch's enqueue time — the
+            # tick's max queue delay, one sample per tick (consumed by
+            # tick()'s note_delay feed).
+            adm_now = time.monotonic()
+            adm_oldest = None
+            adm = self.admission
+            adm_expire = adm.expire_age() if adm.enabled else None
+            expired = []
             with self._submit_lock:
                 for g in sub_groups.tolist():
                     acc_n = int(sub_acc[g])
@@ -2066,8 +2191,51 @@ class RaftNode:
                             q.popleft()
                     self._queued_n[g] -= acc_n
                     self._queued_total -= acc_n
+                # Sojourn sample + late shed over EVERY non-empty queue
+                # — not just groups the device accepted from this tick.
+                # A group whose device log is momentarily full accepts
+                # nothing for a few ticks; its queue must neither rot
+                # invisibly (delay sample) nor past the age cap (late
+                # shed, CoDel's queue drop: the backlog admitted before
+                # the controller engaged would otherwise be served long
+                # past any client deadline).  The oldest entries sit at
+                # the HEAD while the queue is still FIFO (pre-engage
+                # transient) and at the TAIL once LIFO kicks in, so
+                # check both ends.  Only untouched batches (taken == 0)
+                # are expirable; never entries the device accepted.
+                for g, q in self._submissions.items():
+                    if not q:
+                        continue
+                    t0 = min(q[0].t_enq, q[-1].t_enq)
+                    if adm_oldest is None or t0 < adm_oldest:
+                        adm_oldest = t0
+                    if adm_expire is not None:
+                        while q and q[0].taken == 0 \
+                                and adm_now - q[0].t_enq > adm_expire:
+                            self._expire_batch(g, q.popleft(), expired)
+                        while q and q[-1].taken == 0 \
+                                and adm_now - q[-1].t_enq > adm_expire:
+                            self._expire_batch(g, q.pop(), expired)
+            if adm_oldest is not None:
+                self._adm_delay = adm_now - adm_oldest
+            # Fail expired sinks OUTSIDE the submit lock: future done-
+            # callbacks run inline and must not execute under our lock.
+            for g, sink in expired:
+                sink._fail(as_refusal(OverloadError(
+                    f"group {g}: shed from queue after exceeding the "
+                    "overload age cap",
+                    retry_after_s=adm.retry_after())))
         p.own_by_g = own_by_g
         return p
+
+    def _expire_batch(self, g: int, b: "_SubBatch", out: list) -> None:
+        """Unlink one never-accepted batch from the queue accounting
+        (submit lock held; the sink fails after the lock drops)."""
+        nb = len(b.run)
+        self._queued_n[g] -= nb
+        self._queued_total -= nb
+        self.admission.expired += nb
+        out.append((g, b.sink))
 
     def _stage_stable(self, prep: _PersistPrep,
                       mask: Optional[np.ndarray] = None) -> bool:

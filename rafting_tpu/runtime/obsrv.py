@@ -133,6 +133,15 @@ class ObservabilityServer:
             "slo_burn_ratio": float(gauges.get("lat_slo_burn_ratio", 0.0)),
             "io_slow": bool(getattr(n, "_io_slow", False)),
         }
+        # Overload vitals (the admission-control plane, runtime/
+        # admission.py): shedding is DEGRADED, not unhealthy — ``ok``
+        # stays True while the controller keeps admitted-request latency
+        # bounded by refusing the excess; a load balancer should weigh
+        # this node down, not eject it.
+        adm = getattr(n, "admission", None)
+        overload = adm.snapshot() if adm is not None else {
+            "enabled": False, "shedding": False}
+        overload["degraded"] = bool(overload.get("shedding", False))
         return {
             "ok": True,
             "node_id": int(n.node_id),
@@ -142,6 +151,7 @@ class ObservabilityServer:
             "groups_ready": ready,
             "storage": storage,
             "latency": latency,
+            "overload": overload,
             "trace_depth": int(n.cfg.trace_depth),
             "uptime_s": round(time.monotonic() - self._t0, 3),
         }

@@ -16,3 +16,4 @@ from .oracle import oracle_step  # noqa: F401
 from .invariants import ClusterChecker, cluster_snapshot  # noqa: F401
 from . import nemesis  # noqa: F401
 from . import faultfs  # noqa: F401
+from . import openloop  # noqa: F401
