@@ -74,6 +74,10 @@ class RaftStub:
         self._breakers = breakers if breakers is not None \
             else self._shared(container, "_breaker_board", BreakerBoard)
         self._closed = False
+        # Client-history recording (testkit/history.py): None = off, and
+        # the blocking paths pay exactly ONE is-None test — same contract
+        # as the node's latency tracer (tests/test_hotpath_lint.py).
+        self._history = None
 
     @staticmethod
     def _shared(container, attr: str, factory):
@@ -178,11 +182,26 @@ class RaftStub:
         return node.read_batch(self.lane, [enc(q) for q in queries],
                                tenant=self.tenant)
 
+    def attach_history(self, history, proc: str) -> "RaftStub":
+        """Record this stub's blocking calls into ``history`` as client
+        process ``proc`` (testkit/history.py invoke/ok/fail/info; the
+        chaos plane's workload driver turns this on, production code
+        never pays more than the is-None check)."""
+        from ..testkit.history import StubRecorder
+        self._history = StubRecorder(history, proc)
+        return self
+
     def execute_read(self, query: Union[bytes, str],
                      timeout: Optional[float] = None) -> Any:
         """Blocking linearizable read (the read-plane sibling of
         :meth:`execute`); ``timeout`` bounds the whole call including any
         forward-retry chase."""
+        if self._history is not None:
+            return self._history.execute_read(self, query, timeout)
+        return self._execute_read(query, timeout)
+
+    def _execute_read(self, query: Union[bytes, str],
+                      timeout: Optional[float] = None) -> Any:
         tr = getattr(self._container._node, "_lat", None)
         t0 = _time.perf_counter() if tr is not None else 0.0
         fut = self.read(query, timeout=timeout)
@@ -471,7 +490,24 @@ class RaftStub:
         """Blocking submit (reference RaftStub.execute,
         command/RaftStub.java:47-58).  ``timeout`` bounds the whole call,
         INCLUDING any forward-retry chase (the per-call budget the
-        advisor's r4 finding asked for)."""
+        advisor's r4 finding asked for).
+
+        Retry duplicate-safety (the at-most-once contract, see submit):
+        when execute raises an UNMARKED error or a WaitTimeoutError the
+        outcome is UNKNOWN — the command may still commit.  A caller
+        that resubmits after such an error can double-apply; only a
+        MARKED refusal (api/anomaly.py is_refusal) proves the first
+        attempt never entered a log and makes a retry safe.  With
+        history recording attached, unknown outcomes are recorded as
+        ``info`` (never ok/fail) so the linearizability checker accepts
+        either world — committed or not — while a true duplicate apply
+        still surfaces as a non-linearizable read."""
+        if self._history is not None:
+            return self._history.execute(self, command, timeout)
+        return self._execute(command, timeout)
+
+    def _execute(self, command: Union[bytes, str],
+                 timeout: Optional[float] = None) -> Any:
         tr = getattr(self._container._node, "_lat", None)
         t0 = _time.perf_counter() if tr is not None else 0.0
         fut = self.submit(command, timeout=timeout)

@@ -16,12 +16,25 @@ from .spi import Checkpoint
 
 
 class KVMachine:
-    """Commands: JSON bytes {"op": "set"|"del", "k": str, "v": any}."""
+    """Commands: JSON bytes {"op": "set"|"del"|"add", "k": str, "v": any}.
+
+    ``add`` appends to a list value — the chaos workload's observable-
+    duplicate op: a client retry that double-applies shows up as two
+    list elements, which the linearizability checker can then judge
+    (testkit/linz.py).
+
+    ``stale_reads=True`` is a TEST-ONLY defect knob: linearizable reads
+    return each key's PREVIOUS value — the classic stale-read bug a
+    correct ReadIndex/lease plane exists to prevent.  It proves the
+    checker has teeth (tests/test_chaos.py drives it through the real
+    read plane and demands a counterexample)."""
 
     applies_empty = True   # election no-ops advance last_applied, no-op op
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, stale_reads: bool = False):
         self.path = path
+        self.stale_reads = stale_reads
+        self._prev: Dict[str, Any] = {}   # per-key previous value
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self.data: Dict[str, Any] = {}
         self._last_applied = 0
@@ -45,9 +58,17 @@ class KVMachine:
         op = cmd.get("op")
         result = None
         if op == "set":
+            self._prev[cmd["k"]] = self.data.get(cmd["k"])
             self.data[cmd["k"]] = cmd["v"]
             result = cmd["v"]
+        elif op == "add":
+            cur = self.data.get(cmd["k"])
+            self._prev[cmd["k"]] = list(cur) if cur is not None else None
+            lst = self.data.setdefault(cmd["k"], [])
+            lst.append(cmd["v"])
+            result = len(lst)
         elif op == "del":
+            self._prev[cmd["k"]] = self.data.get(cmd["k"])
             result = self.data.pop(cmd["k"], None)
         elif op == "get":
             result = self.data.get(cmd["k"])
@@ -62,6 +83,10 @@ class KVMachine:
         cmd = json.loads(payload)
         if cmd.get("op") != "get":
             raise ValueError(f"read supports op=get only, got {cmd.get('op')!r}")
+        if self.stale_reads:
+            # Injected defect (see class docstring): serve the previous
+            # value, violating linearizability on purpose.
+            return self._prev.get(cmd["k"], self.data.get(cmd["k"]))
         return self.data.get(cmd["k"])
 
     def _dump(self, path: str) -> None:
@@ -104,9 +129,11 @@ class KVMachine:
 
 
 class KVMachineProvider:
-    def __init__(self, root: str):
+    def __init__(self, root: str, stale_reads: bool = False):
         self.root = root
+        self.stale_reads = stale_reads
         os.makedirs(root, exist_ok=True)
 
     def bootstrap(self, group: int) -> KVMachine:
-        return KVMachine(os.path.join(self.root, f"kv_{group}.json"))
+        return KVMachine(os.path.join(self.root, f"kv_{group}.json"),
+                         stale_reads=self.stale_reads)

@@ -643,6 +643,12 @@ class RaftNode:
                    "ckpt_failures", "scrub_ok", "scrub_corrupt",
                    "reconnects_total"):
             self.metrics[_c] += 0
+        # Network-nemesis counters (transport/faults.py): rendered at 0
+        # so a clean cluster exposes the whole injection family and a
+        # chaos run's effects are visible on the ordinary /metrics page.
+        from ..transport.faults import COUNTERS as _FAULT_COUNTERS
+        for _c in _FAULT_COUNTERS:
+            self.metrics[_c] += 0
         self.metrics.gauge("stripes_poisoned", 0)
         self.metrics.gauge("io_backpressure", 0)
         self.metrics.gauge("io_slow", 0)

@@ -20,7 +20,28 @@ import numpy as np
 from ..core.types import EngineConfig, LEADER
 from ..machine.file_machine import FileMachineProvider
 from ..runtime.node import RaftNode
-from ..transport import LoopbackNetwork, LoopbackTransport
+from ..transport import LinkFaults, LoopbackNetwork, LoopbackTransport
+
+
+def scaled_election_mul(tick_ms: int, base: float = 3.0,
+                        floor_ms: float = 150.0) -> float:
+    """Election multiplier with a wall-clock floor for starved hosts.
+
+    On a multi-core host a vote round trip over localhost TCP completes
+    well inside one tick, so ``base`` ticks of election timeout are
+    plenty.  On a 1-vCPU runner, N node processes/threads time-share one
+    core: the leader's heartbeat can sit unscheduled past base*tick_ms,
+    followers start elections they would never start on real hardware,
+    and the test flakes on election churn (the known
+    test_replicated_group_lifecycle_tcp flake, ROADMAP).  Scale the
+    multiplier so the election timeout is at least ``floor_ms`` of wall
+    clock when cores are scarce; on >=4 cores the base wins unchanged.
+    """
+    cores = os.cpu_count() or 1
+    if cores >= 4:
+        return base
+    need = floor_ms / max(1.0, float(tick_ms)) * (2.0 / max(2, cores))
+    return max(base, need)
 
 
 def free_ports(n: int) -> List[int]:
@@ -75,6 +96,11 @@ class LocalCluster:
         self.wal_shards = wal_shards
         self.host_workers = host_workers
         self.net = LoopbackNetwork(cfg.n_peers)
+        # Shared per-directed-link fault table (transport/faults.py):
+        # one instance across every node's transport, so the chaos
+        # conductor mutates a single source of truth for both backends.
+        self.faults = LinkFaults(cfg.n_peers, seed=seed)
+        self.net.faults = self.faults
         self._ports = free_ports(cfg.n_peers) if transport == "tcp" else None
         self.provider_factory = provider_factory or (
             lambda i: FileMachineProvider(
@@ -101,7 +127,8 @@ class LocalCluster:
                                     result_encoder=node.serializer
                                     .encode_result,
                                     read_handler=node.read,
-                                    conf_node=node)
+                                    conf_node=node,
+                                    faults=self.faults)
             return LoopbackTransport(self.net, node_id, self.cfg,
                                      node.template, on_slice,
                                      snapshot_provider,

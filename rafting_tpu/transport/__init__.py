@@ -8,11 +8,13 @@ dense inbox the vectorized engine consumes (inbox.py).  TCP and in-process
 loopback backends share the interface (tcp.py, loopback.py)."""
 
 from .codec import messages_template
+from .faults import LinkAction, LinkFaults
 from .inbox import InboxAccumulator
 from .loopback import LoopbackNetwork, LoopbackTransport
 from .tcp import TcpTransport
 
 __all__ = [
     "messages_template", "InboxAccumulator",
+    "LinkAction", "LinkFaults",
     "LoopbackNetwork", "LoopbackTransport", "TcpTransport",
 ]

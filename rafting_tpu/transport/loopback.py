@@ -10,7 +10,7 @@ TcpTransport, so the node runtime is transport-agnostic.
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from . import codec
 
@@ -27,6 +27,41 @@ class LoopbackNetwork:
         # FaultSchedule.dup, exercising stale/duplicate RPC idempotency
         # through the real codec round-trip)
         self.dup = [[False] * n_nodes for _ in range(n_nodes)]
+        # Optional shared LinkFaults table (transport/faults.py) — the
+        # chaos conductor's richer per-directed-link plane (asymmetric
+        # cuts, probabilistic drop/dup/delay/reorder), consulted in
+        # ADDITION to the legacy conn/dup matrices above.
+        self.faults = None
+        # Frames a delay/reorder verdict held back, per directed link:
+        # (frame, after) — after=False is a delayed frame (delivered
+        # BEFORE the link's next frame: a one-frame time shift, order
+        # kept), after=True is a reordered one (delivered AFTER the next
+        # frame: the adjacent swap).
+        self._held: Dict[Tuple[int, int],
+                         List[Tuple[bytes, bool]]] = {}
+
+    def _take_held(self, key) -> Tuple[list, list]:
+        with self._lock:
+            entries = self._held.pop(key, [])
+        pre = [fr for fr, after in entries if not after]
+        post = [fr for fr, after in entries if after]
+        return pre, post
+
+    def _hold(self, key, frame: bytes, after: bool) -> None:
+        with self._lock:
+            self._held.setdefault(key, []).append((frame, after))
+
+    def flush_held(self) -> None:
+        """Deliver every held-back frame now (heal-time drain so a link
+        that goes quiet doesn't strand a delayed frame forever)."""
+        with self._lock:
+            held, self._held = self._held, {}
+        for (src, dst), entries in held.items():
+            t = self.transports.get(dst)
+            if t is None:
+                continue
+            for frame, _after in entries:
+                t._deliver(frame)
 
     def set_link(self, src: int, dst: int, up: bool) -> None:
         with self._lock:
@@ -97,29 +132,79 @@ class LoopbackTransport:
 
     def send_slice(self, dst: int, packed: bytes) -> None:
         """Deliver a packed MSGS frame to dst (round-trips through the real
-        codec so loopback tests exercise the wire format too)."""
+        codec so loopback tests exercise the wire format too).  When the
+        network carries a LinkFaults table, each frame's fate (cut /
+        drop / delay / dup / reorder) is decided per directed link; held
+        frames ride out with the link's NEXT frame — before it for a
+        delay (order kept, time shifted), after it for a reorder (the
+        adjacent swap)."""
         if not self.net._up(self.node_id, dst):
             return
         t = self.net.transports.get(dst)
         if t is None:
             return  # peer down
+        key = (self.node_id, dst)
+        frames = [packed]
+        f = self.net.faults
+        if f is not None:
+            act = f.plan(self.node_id, dst)
+            if act.cut:
+                self._mirror("net_faults_cut_total")
+                return  # link down: held frames stay held too
+            pre, post = self.net._take_held(key)
+            if not act.deliver:
+                self._mirror("net_faults_dropped_total")
+                frames = []
+            elif act.delay_s > 0:
+                self._mirror("net_faults_delayed_total")
+                self.net._hold(key, packed, after=False)
+                frames = []
+            elif act.reorder:
+                self._mirror("net_faults_reordered_total")
+                self.net._hold(key, packed, after=True)
+                frames = []
+            elif act.dup:
+                self._mirror("net_faults_duplicated_total")
+                frames = [packed, packed]
+            frames = pre + frames + post
         # Duplicate-delivery links (nemesis schedule replay) hand the same
         # frame to the receiver twice — the receiving stack must be
         # idempotent against replayed RPCs, exactly like the device
         # plane's FaultSchedule.dup lane.
         rounds = 2 if self.net._dup(self.node_id, dst) else 1
-        for _ in range(rounds):
-            ftype_body = codec.FrameReader().feed(packed)
-            for ftype, body in ftype_body:
-                if ftype == codec.MSGS:
-                    src, fields, payloads = codec.unpack_slice(
-                        body, t.template, t.cfg.n_groups)
-                    t.on_slice(src, fields, payloads)
+        for frame in frames:
+            for _ in range(rounds):
+                t._deliver(frame)
+
+    def _deliver(self, packed: bytes) -> None:
+        """Receiver half: unpack a frame and merge it into our inbox."""
+        for ftype, body in codec.FrameReader().feed(packed):
+            if ftype == codec.MSGS:
+                src, fields, payloads = codec.unpack_slice(
+                    body, self.template, self.cfg.n_groups)
+                self.on_slice(src, fields, payloads)
+
+    def _mirror(self, name: str) -> None:
+        m = getattr(self, "metrics", None)
+        if m is not None:
+            try:
+                m[name] += 1
+            except Exception:
+                pass
+
+    def _link_open(self, peer: int) -> bool:
+        """Forwards and snapshot fetches are round trips: a cut in either
+        direction — legacy conn matrix or LinkFaults table — fails them."""
+        if not (self.net._up(self.node_id, peer)
+                and self.net._up(peer, self.node_id)):
+            return False
+        f = self.net.faults
+        return f is None or (f.link_up(self.node_id, peer)
+                             and f.link_up(peer, self.node_id))
 
     def forward_submit(self, peer: int, group: int, payload: bytes,
                        timeout: float = 30.0):
-        if not (self.net._up(self.node_id, peer)
-                and self.net._up(peer, self.node_id)):
+        if not self._link_open(peer):
             return False, b"link down"
         t = self.net.transports.get(peer)
         if t is None:
@@ -131,8 +216,7 @@ class LoopbackTransport:
                      timeout: float = 30.0):
         """Relay a linearizable read to the leader (the loopback analog of
         TcpTransport.forward_read — serve side routes to RaftNode.read)."""
-        if not (self.net._up(self.node_id, peer)
-                and self.net._up(peer, self.node_id)):
+        if not self._link_open(peer):
             return False, b"link down"
         t = self.net.transports.get(peer)
         if t is None:
@@ -144,8 +228,7 @@ class LoopbackTransport:
                      timeout: float = 30.0):
         """Relay a membership op (§6 change / leadership transfer) to the
         leader — the loopback analog of TcpTransport.forward_conf."""
-        if not (self.net._up(self.node_id, peer)
-                and self.net._up(peer, self.node_id)):
+        if not self._link_open(peer):
             return False, b"link down"
         t = self.net.transports.get(peer)
         if t is None:
@@ -157,8 +240,7 @@ class LoopbackTransport:
                        ) -> Optional[Tuple[int, int]]:
         """File-to-file snapshot copy (the loopback analog of the TCP
         chunk stream): bytes never accumulate in memory."""
-        if not self.net._up(self.node_id, peer) or \
-                not self.net._up(peer, self.node_id):
+        if not self._link_open(peer):
             return None
         t = self.net.transports.get(peer)
         if t is None or t.snapshot_provider is None:

@@ -44,18 +44,24 @@ class PeerSender:
     """One persistent outbound channel to a peer, with reconnect."""
 
     def __init__(self, my_id: int, peer_id: int, addr: Tuple[str, int],
-                 hello: bytes, metrics=None):
+                 hello: bytes, metrics=None, faults_get=None):
+        """``faults_get()`` (optional) returns the cluster's current
+        LinkFaults table or None — a getter, not the table itself, so the
+        owning transport can install/replace faults at runtime and every
+        sender sees the swap on its next frame."""
         self.my_id = my_id
         self.peer_id = peer_id
         self.addr = addr
         self.hello = hello
         self.metrics = metrics
+        self.faults_get = faults_get
         self.q: "queue.Queue[bytes]" = queue.Queue(SEND_QUEUE_CAP)
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name=f"raft-send-{my_id}->{peer_id}",
             daemon=True)
         self.connected = False
+        self._held: Optional[bytes] = None  # reorder nemesis holdback
 
     def start(self):
         self._thread.start()
@@ -88,9 +94,38 @@ class PeerSender:
                    RECONNECT_DELAY * (2.0 ** min(attempts - 1, 6)))
         return base * (0.5 + 0.5 * random.random())
 
+    def _count(self, name: str) -> None:
+        if self.metrics is not None:
+            try:
+                self.metrics[name] += 1
+            except Exception:  # metrics must never kill the sender
+                pass
+
+    def _flush_held(self, sock) -> None:
+        """Send a frame the reorder nemesis held back — after the next
+        frame (the adjacent swap), or on queue idle so it never starves."""
+        if self._held is not None:
+            h, self._held = self._held, None
+            sock.sendall(h)
+
+    def _faults(self):
+        return self.faults_get() if self.faults_get is not None else None
+
     def _run(self):
         attempts = 0
         while not self._stop.is_set():
+            f = self._faults()
+            if f is not None and not f.link_up(self.my_id, self.peer_id):
+                # Injected partition: behave exactly like an unreachable
+                # peer — count a reconnect attempt and climb the backoff
+                # ladder, so a flapping partition exercises the same
+                # jittered-exponential path a flapping switch would.
+                attempts += 1
+                self._count("reconnects_total")
+                # Full jittered-exponential ladder, but capped at 2s so a
+                # healed partition is noticed promptly in bounded tests.
+                self._stop.wait(min(2.0, self._backoff(attempts)))
+                continue
             sock = None
             try:
                 sock = socket.create_connection(self.addr, timeout=5)
@@ -102,12 +137,40 @@ class PeerSender:
                     try:
                         data = self.q.get(timeout=0.5)
                     except queue.Empty:
+                        self._flush_held(sock)
+                        continue
+                    f = self._faults()
+                    if f is None:
+                        sock.sendall(data)
+                        continue
+                    act = f.plan(self.my_id, self.peer_id)
+                    if act.cut:
+                        # Partition dropped mid-connection: sever like a
+                        # network failure.  The dequeued frame is lost
+                        # (Raft resends on timeout), and so is any held
+                        # one — buffered bytes die with the connection.
+                        self._count("net_faults_cut_total")
+                        raise OSError("injected link cut")
+                    if not act.deliver:
+                        self._count("net_faults_dropped_total")
+                        continue
+                    if act.delay_s > 0:
+                        self._count("net_faults_delayed_total")
+                        self._stop.wait(act.delay_s)
+                    if act.reorder and self._held is None:
+                        self._count("net_faults_reordered_total")
+                        self._held = data
                         continue
                     sock.sendall(data)
+                    if act.dup:
+                        self._count("net_faults_duplicated_total")
+                        sock.sendall(data)
+                    self._flush_held(sock)
             except OSError:
                 pass
             finally:
                 self.connected = False
+                self._held = None
                 if sock is not None:
                     try:
                         sock.close()
@@ -115,11 +178,7 @@ class PeerSender:
                         pass
             if not self._stop.is_set():
                 attempts += 1
-                if self.metrics is not None:
-                    try:
-                        self.metrics["reconnects_total"] += 1
-                    except Exception:  # metrics must never kill the sender
-                        pass
+                self._count("reconnects_total")
                 # stop.wait, not sleep: close() shouldn't stall on backoff
                 self._stop.wait(self._backoff(attempts))
 
@@ -140,7 +199,7 @@ class TcpTransport:
                  submit_handler: Optional[Callable] = None,
                  result_encoder: Optional[Callable] = None,
                  read_handler: Optional[Callable] = None,
-                 conf_node=None):
+                 conf_node=None, faults=None):
         """``submit_handler(group, payload) -> Future`` serves forwarded
         client commands (None -> forwards are refused).
         ``read_handler(group, payload) -> Future`` serves forwarded
@@ -149,8 +208,13 @@ class TcpTransport:
         (the node's CmdSerializer, api/serial.py; default JSON).
         ``conf_node`` serves forwarded membership ops (FWD_CONF): any
         object with change_membership/transfer_leadership — normally the
-        RaftNode itself (None -> membership forwards refused)."""
+        RaftNode itself (None -> membership forwards refused).
+        ``faults``: an optional shared LinkFaults table (transport/
+        faults.py) — assignable at runtime (``transport.faults = ...``);
+        sender threads read it through a getter so a mid-run swap takes
+        effect on the next frame."""
         self.node_id = node_id
+        self.faults = faults
         self.peers = peers
         self.cfg = cfg
         self.template = template
@@ -185,7 +249,8 @@ class TcpTransport:
             if pid == self.node_id:
                 continue
             s = PeerSender(self.node_id, pid, addr, self._hello,
-                           metrics=getattr(self, "metrics", None))
+                           metrics=getattr(self, "metrics", None),
+                           faults_get=lambda: self.faults)
             s.start()
             self._senders[pid] = s
 
@@ -221,6 +286,8 @@ class TcpTransport:
         side is a zero-copy sendfile), and snapshot size is unbounded by
         MAX_BODY.  Blocking — call from a worker thread.  Returns
         (index, term) or None."""
+        if not self._link_open(peer):
+            return None
         try:
             with socket.create_connection(self.peers[peer],
                                           timeout=timeout) as sock:
@@ -263,6 +330,14 @@ class TcpTransport:
             # Malformed frames / unknown peer fail like any transport error.
             log.debug("snapshot fetch from %d failed: %s", peer, e)
             return None
+
+    def _link_open(self, peer: int) -> bool:
+        """Ephemeral channels (forward / snapshot fetch) respect injected
+        partitions too: a cut in EITHER direction fails the round trip —
+        these connections need both the request and the reply to pass."""
+        f = self.faults
+        return f is None or (f.link_up(self.node_id, peer)
+                             and f.link_up(peer, self.node_id))
 
     # -- inbound -------------------------------------------------------------
 
@@ -363,6 +438,8 @@ class TcpTransport:
                      timeout: float = 30.0) -> Tuple[bool, bytes]:
         """Relay a membership op (§6 change / leadership transfer) to
         ``peer`` over an ephemeral FWD_CONF connection."""
+        if not self._link_open(peer):
+            return False, b"link cut (fault injection)"
         try:
             with socket.create_connection(self.peers[peer],
                                           timeout=timeout) as sock:
@@ -381,6 +458,8 @@ class TcpTransport:
 
     def _forward(self, peer: int, group: int, payload: bytes,
                  timeout: float, ftype: int) -> Tuple[bool, bytes]:
+        if not self._link_open(peer):
+            return False, b"link cut (fault injection)"
         try:
             with socket.create_connection(self.peers[peer],
                                           timeout=timeout) as sock:

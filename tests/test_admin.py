@@ -9,7 +9,8 @@ import time
 
 import pytest
 
-from rafting_tpu.testkit.harness import free_ports as _free_ports
+from rafting_tpu.testkit.harness import (
+    free_ports as _free_ports, scaled_election_mul)
 
 from rafting_tpu.admin import (
     DESTROYED, NORMAL, SLEEPING, Administrator, KVEngine, LifecycleBus, STM,
@@ -232,7 +233,14 @@ def test_replicated_group_lifecycle_tcp(tmp_path):
             local=uris[i],
             peers=tuple(u for j, u in enumerate(uris) if j != i),
             n_groups=4, log_slots=32, batch=4, max_submit=4,
-            tick_ms=10, data_dir=str(tmp_path / f"node{i}"), seed=3)
+            tick_ms=10, data_dir=str(tmp_path / f"node{i}"), seed=3,
+            # Flake fix: on a 1-vCPU runner three full TCP nodes
+            # time-share one core, so the default 3-tick (30ms) election
+            # timeout expires while the leader's heartbeat thread is
+            # simply descheduled, and the test churns elections forever.
+            # Scale the multiplier to a wall-clock floor (150ms here);
+            # on >=4 cores this is exactly the old election_mul=3.
+            election_mul=scaled_election_mul(10))
         cs.append(RaftContainer(cfg).create())
     try:
         # ONE node opens; the lifecycle replicates to all.

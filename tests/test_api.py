@@ -10,7 +10,8 @@ import time
 import numpy as np
 import pytest
 
-from rafting_tpu.testkit.harness import free_ports as _free_ports
+from rafting_tpu.testkit.harness import (
+    free_ports as _free_ports, scaled_election_mul)
 
 from rafting_tpu.api import (
     ADMIN_GROUP, NotLeaderError, ObsoleteContextError, RaftConfig,
@@ -78,7 +79,11 @@ def tcp_cluster(tmp_path):
             local=uris[i],
             peers=tuple(u for j, u in enumerate(uris) if j != i),
             n_groups=4, log_slots=32, batch=4, max_submit=4,
-            tick_ms=10, data_dir=str(tmp_path / f"node{i}"), seed=7)
+            tick_ms=10, data_dir=str(tmp_path / f"node{i}"), seed=7,
+            # Same flake fix as test_admin's TCP lifecycle test: on a
+            # starved (1-vCPU) runner a 30ms election timeout loses to
+            # scheduler hiccups; floor it at 150ms of wall clock.
+            election_mul=scaled_election_mul(10))
         containers.append(RaftContainer(cfg).create())
     yield containers
     for c in containers:

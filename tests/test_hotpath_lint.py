@@ -56,6 +56,26 @@ def test_send_plane_uses_section_packing():
             inspect.getsource(getattr(RaftNode, name)), name
 
 
+def test_stub_history_gate_is_single_is_none_test():
+    """Client-history recording (testkit/history.py) must cost exactly
+    one ``is None`` test per blocking call when disabled — the same
+    contract as the node's latency tracer.  A recorder lookup, dict get,
+    or try/except on the disabled path would tax every production
+    execute/execute_read to subsidize a test-only feature."""
+    from rafting_tpu.api.stub import RaftStub
+    for name in ("execute", "execute_read"):
+        src = inspect.getsource(getattr(RaftStub, name))
+        gates = src.count("self._history is not None")
+        assert gates == 1, (
+            f"RaftStub.{name} must gate history recording behind exactly "
+            f"one 'self._history is not None' test (found {gates}); the "
+            f"recorder itself lives entirely behind it")
+        # The disabled path falls straight through to the private impl —
+        # no attribute juggling, no exception handling on this frame.
+        assert "getattr" not in src and "try:" not in src, (
+            f"RaftStub.{name} grew logic on the history-disabled path")
+
+
 def test_columnar_gates_present():
     """Positive checks: the columnar structures the loops were replaced
     WITH are still the mechanism (guards against a rewrite that drops

@@ -1,0 +1,238 @@
+"""Unit tests for the history model (testkit/history.py) and the Wing &
+Gong linearizability checker (testkit/linz.py).
+
+The load-bearing cases are the Jepsen classification corners: ``info``
+(outcome unknown) writes may linearize or vanish, marked-refusal
+``fail`` writes must NOT appear, and a client retry of an
+unknown-outcome append is legal exactly when the first attempt was
+recorded ``info`` — the retry duplicate-safety contract documented on
+RaftStub.execute."""
+
+import json
+import math
+
+import pytest
+
+from rafting_tpu.api.anomaly import (
+    NotLeaderError, WaitTimeoutError, as_refusal)
+from rafting_tpu.testkit import linz
+from rafting_tpu.testkit.history import History, Op, StubRecorder
+
+
+def _op(i, kind, key, value=None, status="ok", result=None, inv=0,
+        resp=None, proc="p"):
+    if resp is None:
+        resp = math.inf if status == "info" else inv + 0.5
+    return Op(id=i, proc=proc, kind=kind, key=key, value=value,
+              status=status, result=result, invoke_seq=inv, resp_seq=resp)
+
+
+# ------------------------------------------------------------- the model --
+
+def test_sequential_register_reads():
+    ops = [_op(0, "w", "x", 1, inv=0, resp=1),
+           _op(1, "r", "x", result=1, inv=2, resp=3),
+           _op(2, "w", "x", 2, inv=4, resp=5),
+           _op(3, "r", "x", result=2, inv=6, resp=7)]
+    assert linz.check(ops).ok
+
+
+def test_stale_read_is_flagged():
+    """Two writes complete strictly before the read is invoked: real-time
+    order pins w1 < w2 < r, so r returning the OLD value is the classic
+    stale read — exactly the defect the KV machine's test knob injects."""
+    ops = [_op(0, "w", "x", 1, inv=0, resp=1),
+           _op(1, "w", "x", 2, inv=2, resp=3),
+           _op(2, "r", "x", result=1, inv=4, resp=5)]
+    res = linz.check(ops)
+    assert not res.ok and res.key == "x"
+    assert "NON-LINEARIZABLE" in res.render()
+
+
+def test_concurrent_write_read_may_see_either():
+    # Read overlaps the write: old and new value are both legal.
+    base = [_op(0, "w", "x", 1, inv=0, resp=1),
+            _op(1, "w", "x", 2, inv=2, resp=6)]
+    assert linz.check(base + [_op(2, "r", "x", result=1, inv=3,
+                                  resp=4)]).ok
+    assert linz.check(base + [_op(2, "r", "x", result=2, inv=3,
+                                  resp=4)]).ok
+    assert not linz.check(base + [_op(2, "r", "x", result=7, inv=3,
+                                      resp=4)]).ok
+
+
+def test_info_write_may_happen_or_not():
+    """An unknown-outcome write is forever-concurrent: a later read may
+    see it (it committed eventually) or never see it (it was lost)."""
+    base = [_op(0, "w", "x", 1, inv=0, resp=1),
+            _op(1, "w", "x", 2, status="info", inv=2)]
+    assert linz.check(base + [_op(2, "r", "x", result=1, inv=4,
+                                  resp=5)]).ok
+    assert linz.check(base + [_op(2, "r", "x", result=2, inv=4,
+                                  resp=5)]).ok
+    # ...and it may even take effect AFTER a read that missed it.
+    assert linz.check(base + [_op(2, "r", "x", result=1, inv=4, resp=5),
+                              _op(3, "r", "x", result=2, inv=6,
+                                  resp=7)]).ok
+
+
+def test_failed_write_must_not_appear():
+    """A MARKED refusal is a promise the command never entered any log;
+    a read observing it anyway is a soundness violation."""
+    ops = [_op(0, "w", "x", 1, inv=0, resp=1),
+           _op(1, "w", "x", 2, status="fail", inv=2, resp=3),
+           _op(2, "r", "x", result=2, inv=4, resp=5)]
+    assert not linz.check(ops).ok
+
+
+def test_info_write_before_invoke_is_illegal():
+    # Even an info op cannot take effect BEFORE its invocation.
+    ops = [_op(0, "r", "x", result=5, inv=0, resp=1),
+           _op(1, "w", "x", 5, status="info", inv=2)]
+    assert not linz.check(ops).ok
+
+
+# ----------------------------------------------- retry duplicate-safety --
+
+def test_duplicate_append_legal_iff_first_attempt_was_info():
+    """The at-most-once contract (RaftStub.execute docstring): a client
+    that resubmits after an UNKNOWN outcome may double-apply.  The
+    history stays sound because the first attempt is ``info``: a read
+    seeing the value once or twice both verify.  Recording that same
+    first attempt as ``fail`` (as if it provably never happened) makes
+    the double-apply a checker violation — a duplicate apply is always
+    surfaced, never silently accepted."""
+    retry = [_op(1, "a", "l", "v", status="info", inv=1),
+             _op(2, "a", "l", "v", inv=3, resp=4)]
+    once = [_op(3, "r", "l", result=["v"], inv=5, resp=6)]
+    twice = [_op(3, "r", "l", result=["v", "v"], inv=5, resp=6)]
+    assert linz.check(retry + once).ok      # first attempt lost
+    assert linz.check(retry + twice).ok     # first attempt committed too
+    misrecorded = [_op(1, "a", "l", "v", status="fail", inv=1, resp=2),
+                   _op(2, "a", "l", "v", inv=3, resp=4)]
+    assert linz.check(misrecorded + once).ok
+    assert not linz.check(misrecorded + twice).ok   # duplicate surfaced
+    thrice = [_op(3, "r", "l", result=["v", "v", "v"], inv=5, resp=6)]
+    assert not linz.check(retry + thrice).ok        # 2 attempts, 3 applies
+
+
+def test_append_order_must_match_observed_list():
+    ops = [_op(0, "a", "l", "a", inv=0, resp=1),
+           _op(1, "a", "l", "b", inv=2, resp=3),
+           _op(2, "r", "l", result=["b", "a"], inv=4, resp=5)]
+    assert not linz.check(ops).ok
+    ops[2] = _op(2, "r", "l", result=["a", "b"], inv=4, resp=5)
+    assert linz.check(ops).ok
+
+
+# -------------------------------------------- counterexamples & locality --
+
+def test_counterexample_is_minimal_prefix():
+    """Shrinking keeps only the shortest failing response-prefix: noise
+    appended after the witness read must not appear."""
+    ops = [_op(0, "w", "x", 1, inv=0, resp=1),
+           _op(1, "w", "x", 2, inv=2, resp=3),
+           _op(2, "r", "x", result=1, inv=4, resp=5)]   # the witness
+    noise = [_op(10 + i, "w", "x", 100 + i, inv=10 + 2 * i,
+                 resp=11 + 2 * i) for i in range(8)]
+    res = linz.check(ops + noise)
+    assert not res.ok
+    assert {o.id for o in res.counterexample} <= {0, 1, 2}
+    assert any(o.id == 2 for o in res.counterexample)
+
+
+def test_per_key_compositionality():
+    good = [_op(0, "w", "x", 1, inv=0, resp=1),
+            _op(1, "r", "x", result=1, inv=2, resp=3)]
+    bad = [_op(2, "w", "y", 1, inv=4, resp=5),
+           _op(3, "r", "y", result=9, inv=6, resp=7)]
+    res = linz.check(good + bad)
+    assert not res.ok and res.key == "y"
+    assert res.checked_keys == 2 and res.n_ops == 4
+
+
+def test_vacuous_histories_pass():
+    assert linz.check([]).ok
+    assert linz.check([_op(0, "w", "x", 1, status="info", inv=0)]).ok
+    assert linz.check([_op(0, "w", "x", 1, status="fail", inv=0,
+                           resp=1)]).ok
+
+
+# ----------------------------------------------------- history recording --
+
+class _FakeStub:
+    """Duck-typed stand-in exposing the renamed raw paths the recorder
+    wraps (api/stub.py: execute -> _execute under the history gate)."""
+
+    def __init__(self, behavior):
+        self._behavior = behavior
+
+    def _execute(self, command, timeout):
+        return self._behavior(command)
+
+    def _execute_read(self, query, timeout):
+        return self._behavior(query)
+
+
+def test_recorder_classification_rule():
+    h = History()
+    rec = StubRecorder(h, "c0")
+    set_cmd = json.dumps({"op": "set", "k": "x", "v": 1})
+    # ok
+    assert rec.execute(_FakeStub(lambda c: 1), set_cmd, None) == 1
+    # MARKED refusal -> fail (provably never happened)
+    with pytest.raises(NotLeaderError):
+        rec.execute(_FakeStub(
+            lambda c: (_ for _ in ()).throw(
+                as_refusal(NotLeaderError("hint")))), set_cmd, None)
+    # unmarked NotLeader (accept-then-abort) -> info, NOT fail
+    with pytest.raises(NotLeaderError):
+        rec.execute(_FakeStub(
+            lambda c: (_ for _ in ()).throw(NotLeaderError("late"))),
+            set_cmd, None)
+    # timeout -> info (still in flight)
+    with pytest.raises(WaitTimeoutError):
+        rec.execute(_FakeStub(
+            lambda c: (_ for _ in ()).throw(WaitTimeoutError("t"))),
+            set_cmd, None)
+    ops = {o.id: o for o in h.ops()}
+    assert [ops[i].status for i in range(4)] == \
+        ["ok", "fail", "info", "info"]
+    assert ops[1].error == "NotLeaderError"
+    assert math.isinf(ops[2].resp_seq) and math.isinf(ops[3].resp_seq)
+    assert h.counts() == {"ok": 1, "fail": 1, "info": 2}
+
+
+def test_recorder_parses_kv_vocabulary_and_fallback():
+    h = History()
+    rec = StubRecorder(h, "c1")
+    rec.execute(_FakeStub(lambda c: 2),
+                json.dumps({"op": "add", "k": "l", "v": "e"}), None)
+    rec.execute_read(_FakeStub(lambda c: ["e"]),
+                     json.dumps({"op": "get", "k": "l"}), None)
+    rec.execute(_FakeStub(lambda c: None), b"\x00raw-bytes", None)
+    ops = h.ops()
+    assert (ops[0].kind, ops[0].key, ops[0].value) == ("a", "l", "e")
+    assert (ops[1].kind, ops[1].key, ops[1].result) == ("r", "l", ["e"])
+    assert (ops[2].kind, ops[2].key) == ("w", "__cmd__")
+
+
+def test_recorded_result_is_snapshotted():
+    """A read returning a LIVE machine object (the KV machine hands out
+    its actual list) must be recorded by value: later mutation of the
+    returned object cannot rewrite what the read saw."""
+    h = History()
+    rec = StubRecorder(h, "c0")
+    live = ["a"]
+    rec.execute_read(_FakeStub(lambda c: live),
+                     json.dumps({"op": "get", "k": "l"}), None)
+    live.append("b")
+    assert h.ops()[0].result == ["a"]
+
+
+def test_history_unpaired_invoke_is_info_forever():
+    h = History()
+    h.invoke("c0", "w", "x", 1)   # the client thread died mid-call
+    (op,) = h.ops()
+    assert op.status == "info" and math.isinf(op.resp_seq)
+    assert linz.check(h).ok

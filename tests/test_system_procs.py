@@ -6,153 +6,60 @@ cluster/TestNode1.java:16-56, cluster/LogChecker.java:9-37).
 
 The in-process system test (test_system_tcp.py) shares one interpreter/GIL
 across all nodes; this one proves the deployment shape — separate address
-spaces, hard kills, crash recovery from disk alone.
-"""
+spaces, hard kills, crash recovery from disk alone.  The process plumbing
+(spawn/status/kill/oracles) lives in testkit/chaos.py ProcCluster, shared
+with the seeded SIGKILL chaos schedule (tests/test_chaos.py)."""
 
-import json
-import os
-import signal
-import subprocess
-import sys
-import time
-
-import pytest
-
-from rafting_tpu.testkit.harness import free_ports
+from rafting_tpu.testkit.chaos import ProcCluster
 from rafting_tpu.testkit.logcheck import check_logs
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-XML = """<raft>
-  <cluster>
-    <local>{local}</local>
-    {remotes}
-  </cluster>
-  <timing tick="10" heartbeat="1" election="3" broadcast="0.5" pre-vote="true"/>
-  <engine groups="4" log-slots="64" batch="8" max-submit="8"/>
-  <snapshot state-change-threshold="64" dirty-log-tolerance="16"
-            snap-min-interval="20" compact-min-interval="10" slack="8"/>
-  <storage dir="{data_dir}"/>
-</raft>
-"""
-
-
-def _write_cfg(tmp_path, uris, i):
-    remotes = "\n    ".join(f"<remote>{u}</remote>"
-                            for j, u in enumerate(uris) if j != i)
-    p = tmp_path / f"node{i}.xml"
-    p.write_text(XML.format(local=uris[i], remotes=remotes,
-                            data_dir=str(tmp_path / f"node{i}")))
-    return str(p)
-
-
-def _spawn(tmp_path, cfg_path, i):
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO
-    env["JAX_PLATFORMS"] = "cpu"
-    out = open(tmp_path / f"node{i}.out", "ab")
-    return subprocess.Popen(
-        [sys.executable, "-m", "rafting_tpu.tools.noderun", cfg_path],
-        env=env, cwd=REPO, stdout=out, stderr=out)
-
-
-def _status(tmp_path, i):
-    try:
-        with open(tmp_path / f"node{i}" / "status.json") as f:
-            return json.load(f)
-    except (OSError, ValueError):
-        return None
-
-
-def _total_acked(tmp_path, alive):
-    total = 0
-    for i in alive:
-        s = _status(tmp_path, i)
-        if s:
-            total += s["acked"]
-    return total
-
-
-def _wait(pred, what, timeout):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if pred():
-            return
-        time.sleep(0.25)
-    raise AssertionError(f"{what} not reached in {timeout}s")
-
-
-def _machine_lines(tmp_path, i, lane):
-    p = tmp_path / f"node{i}" / "machines" / f"group_{lane}.txt"
-    if not p.exists():
-        return []
-    return p.read_text().splitlines()
 
 
 def test_three_process_cluster_kill9_restart(tmp_path):
-    ports = free_ports(3)
-    uris = [f"raft://127.0.0.1:{p}" for p in ports]
-    cfgs = [_write_cfg(tmp_path, uris, i) for i in range(3)]
-    procs = {i: _spawn(tmp_path, cfgs[i], i) for i in range(3)}
+    pc = ProcCluster(tmp_path, n=3, groups=4)
+    pc.start_all()
     try:
         # All three processes up, group opened, lane agreed (compiles are
         # the long pole: three interpreters each jit the engine).
-        def ready(i):
-            out = (tmp_path / f"node{i}.out")
-            return out.exists() and b"READY lane=" in out.read_bytes()
-        _wait(lambda: all(ready(i) for i in range(3)),
-              "all nodes READY", timeout=240)
+        pc.wait(lambda: all(pc.ready_count(i) >= 1 for i in range(3)),
+                "all nodes READY", timeout=240)
         lanes = set()
         for i in range(3):
-            for ln in (tmp_path / f"node{i}.out").read_bytes().splitlines():
-                if ln.startswith(b"READY lane="):
-                    lanes.add(int(ln.split(b"=")[1].split(b" ")[0]))
+            lanes.update(pc.ready_lanes(i))
         assert len(lanes) == 1, f"nodes disagree on the lane: {lanes}"
         lane = lanes.pop()
 
-        _wait(lambda: _total_acked(tmp_path, range(3)) >= 30,
-              # 240s: three processes serialize their XLA compiles on a
-              # single-core host before any of them can tick usefully —
-              # 120s was a ~25% flake under load.
-              "initial load committed", timeout=240)
+        pc.wait(lambda: pc.total_acked() >= 30,
+                # 240s: three processes serialize their XLA compiles on a
+                # single-core host before any of them can tick usefully —
+                # 120s was a ~25% flake under load.
+                "initial load committed", timeout=240)
 
         # kill -9 the current leader (the reference's operator action).
-        def leader():
-            for i in range(3):
-                s = _status(tmp_path, i)
-                if s and s.get("leader"):
-                    return i
-            return None
-        _wait(lambda: leader() is not None, "leader visible", timeout=60)
-        victim = leader()
-        os.kill(procs[victim].pid, signal.SIGKILL)
-        procs[victim].wait(timeout=10)
+        pc.wait(lambda: pc.leader() is not None, "leader visible",
+                timeout=60)
+        victim = pc.leader()
+        pc.sigkill(victim)
         survivors = [i for i in range(3) if i != victim]
 
-        base = _total_acked(tmp_path, survivors)
-        _wait(lambda: _total_acked(tmp_path, survivors) >= base + 20,
-              "progress after kill -9", timeout=120)
+        base = pc.total_acked(survivors)
+        pc.wait(lambda: pc.total_acked(survivors) >= base + 20,
+                "progress after kill -9", timeout=120)
 
         # Cold restart from disk; must rejoin, catch up, keep committing.
-        procs[victim] = _spawn(tmp_path, cfgs[victim], victim)
-        _wait(lambda: (tmp_path / f"node{victim}.out").read_bytes()
-              .count(b"READY lane=") >= 2, "victim rejoined", timeout=240)
-        base2 = _total_acked(tmp_path, range(3))
-        _wait(lambda: _total_acked(tmp_path, range(3)) >= base2 + 20,
-              "progress after restart", timeout=120)
+        pc.start(victim)
+        pc.wait(lambda: pc.ready_count(victim) >= 2, "victim rejoined",
+                timeout=240)
+        base2 = pc.total_acked()
+        pc.wait(lambda: pc.total_acked() >= base2 + 20,
+                "progress after restart", timeout=120)
 
         # Graceful stop: SIGTERM everywhere; runners stop load, drain, close.
-        for p in procs.values():
-            p.send_signal(signal.SIGTERM)
-        for p in procs.values():
-            assert p.wait(timeout=120) == 0
+        assert pc.sigterm_all() == [0, 0, 0]
     finally:
-        for p in procs.values():
-            if p.poll() is None:
-                p.kill()
+        pc.close()
 
     # Oracle 1: byte-identical machine files (README.md:28-33).
-    files = [_machine_lines(tmp_path, i, lane) for i in range(3)]
+    files = [pc.machine_lines(i, lane) for i in range(3)]
     assert len(files[0]) >= 50
     assert files[0] == files[1] == files[2]
     # Oracle 2: every payload a client saw acknowledged survives exactly
@@ -160,11 +67,9 @@ def test_three_process_cluster_kill9_restart(tmp_path):
     # was later SIGKILLed).
     body = [l.split(":", 1)[1].strip() for l in files[0]]
     for i in range(3):
-        p = tmp_path / f"node{i}" / "acked.txt"
-        acked = p.read_text().split() if p.exists() else []
-        for payload in acked:
+        for payload in pc.acked_payloads(i):
             assert body.count(payload) == 1, \
                 f"acked {payload} appears {body.count(payload)}x"
     # Oracle 3: offline WAL diff (LogChecker analog).
-    divs = check_logs([str(tmp_path / f"node{i}" / "wal") for i in range(3)])
+    divs = check_logs(pc.wal_dirs())
     assert divs == [], f"log divergence: {divs[:5]}"

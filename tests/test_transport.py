@@ -298,3 +298,234 @@ def test_reconnect_counter_on_dead_peer():
     assert time.monotonic() - t0 < 5   # stop never waits out the backoff
     assert m["reconnects_total"] >= 1
     assert not s.connected
+
+
+# ------------------------------------------------------- fault injection --
+# The chaos plane's network nemesis (transport/faults.py): per-directed-
+# link cut/drop/delay/dup/reorder, runtime-togglable, consulted by BOTH
+# backends — these tests pin the per-backend delivery semantics.
+
+from rafting_tpu.transport import (  # noqa: E402
+    LinkFaults, LoopbackNetwork, LoopbackTransport)
+from rafting_tpu.utils.metrics import Metrics  # noqa: E402
+
+
+def test_linkfaults_asymmetric_and_partition():
+    f = LinkFaults(3, seed=1)
+    f.set_link(0, 1, False)          # A->B dead...
+    assert f.plan(0, 1).cut
+    assert f.plan(1, 0) == (True, False, 0.0, False, False)  # ...B->A alive
+    f.restore(0, 1)
+    assert not f.plan(0, 1).cut
+    f.partition([[0], [1, 2]])
+    assert f.plan(1, 2).deliver and f.plan(2, 1).deliver
+    assert f.plan(0, 1).cut and f.plan(1, 0).cut and f.plan(2, 0).cut
+    assert not f.link_up(0, 2) and f.link_up(1, 2)
+    f.heal()
+    assert f.plan(0, 1).deliver and f.plan(0, 2).deliver
+    assert f.snapshot()["counters"]["cut"] == 4
+
+
+def test_linkfaults_plan_deterministic_per_link():
+    """Fault verdicts are a pure function of (seed, link, frame count):
+    same seed replays the identical stream, another link's traffic never
+    perturbs it — the property that makes a seeded soak replayable."""
+    spec = dict(drop_p=0.3, dup_p=0.2, reorder_p=0.2, delay_p=0.1,
+                delay_s=0.01)
+    a, b, c = (LinkFaults(2, seed=42), LinkFaults(2, seed=42),
+               LinkFaults(2, seed=43))
+    for t in (a, b, c):
+        t.set_flaky(0, 1, **spec)
+    sa = [a.plan(0, 1) for _ in range(300)]
+    assert sa == [b.plan(0, 1) for _ in range(300)]
+    assert sa != [c.plan(0, 1) for _ in range(300)]
+    d = LinkFaults(2, seed=42)
+    d.set_flaky(0, 1, **spec)
+    d.set_flaky(1, 0, drop_p=0.5)
+    interleaved = []
+    for _ in range(300):
+        interleaved.append(d.plan(0, 1))
+        d.plan(1, 0)                 # concurrent reverse-link traffic
+    assert interleaved == sa
+
+
+def _rv_frame(term, src=0):
+    f = {name: np.zeros((CFG.n_groups,) + trail, dt)
+         for name, (dt, trail) in messages_template(CFG).items()}
+    f["rv_valid"][3] = True
+    f["rv_term"][3] = term
+    return codec.pack_slice(src, f, None)
+
+
+def _loop_pair(seed=0):
+    net = LoopbackNetwork(2)
+    got = {0: [], 1: []}
+    ts = {}
+    tmpl = messages_template(CFG)
+    for i in (0, 1):
+        ts[i] = LoopbackTransport(
+            net, i, CFG, tmpl,
+            on_slice=lambda src, fields, payloads, _i=i:
+                got[_i].append(int(fields["rv_term"][1][0])))
+        ts[i].start()
+    net.faults = LinkFaults(2, seed=seed)
+    return net, ts, got
+
+
+def test_loopback_fault_drop_dup_asymmetric():
+    net, ts, got = _loop_pair()
+    ts[0].metrics = Metrics()
+    net.faults.set_flaky(0, 1, drop_p=1.0)
+    ts[0].send_slice(1, _rv_frame(5))
+    assert got[1] == []                      # dropped
+    net.faults.set_flaky(0, 1, dup_p=1.0)
+    ts[0].send_slice(1, _rv_frame(6))
+    assert got[1] == [6, 6]                  # duplicated
+    ts[1].send_slice(0, _rv_frame(9, src=1))
+    assert got[0] == [9]                     # reverse link untouched
+    assert ts[0].metrics["net_faults_dropped_total"] == 1
+    assert ts[0].metrics["net_faults_duplicated_total"] == 1
+    snap = net.faults.snapshot()["counters"]
+    assert snap["dropped"] == 1 and snap["duplicated"] == 1
+
+
+def test_loopback_delay_keeps_order_reorder_swaps():
+    """Holdback semantics: a DELAYED frame rides out before the link's
+    next frame (time shifted, order kept); a REORDERED frame rides out
+    after it (the adjacent swap); heal drains held frames."""
+    net, ts, got = _loop_pair()
+    f = net.faults
+    f.set_flaky(0, 1, delay_p=1.0, delay_s=0.01)
+    ts[0].send_slice(1, _rv_frame(1))
+    assert got[1] == []                      # held
+    f.set_flaky(0, 1)                        # clear
+    ts[0].send_slice(1, _rv_frame(2))
+    assert got[1] == [1, 2]                  # delay: order preserved
+    f.set_flaky(0, 1, reorder_p=1.0)
+    ts[0].send_slice(1, _rv_frame(3))
+    assert got[1] == [1, 2]                  # held
+    f.set_flaky(0, 1)
+    ts[0].send_slice(1, _rv_frame(4))
+    assert got[1] == [1, 2, 4, 3]            # reorder: adjacent swap
+    f.set_flaky(0, 1, reorder_p=1.0)
+    ts[0].send_slice(1, _rv_frame(7))
+    f.set_link(0, 1, False)
+    ts[0].send_slice(1, _rv_frame(8))        # cut: lost, held stays held
+    assert got[1] == [1, 2, 4, 3]
+    f.restore(0, 1)
+    net.flush_held()                         # heal-time drain
+    assert got[1] == [1, 2, 4, 3, 7]
+
+
+def test_loopback_partition_heal_midrun():
+    net, ts, got = _loop_pair()
+    net.faults.partition([[0], [1]])
+    ts[0].send_slice(1, _rv_frame(1))
+    ts[1].send_slice(0, _rv_frame(2, src=1))
+    assert got == {0: [], 1: []}
+    net.faults.heal()
+    ts[0].send_slice(1, _rv_frame(3))
+    ts[1].send_slice(0, _rv_frame(4, src=1))
+    assert got == {0: [4], 1: [3]}
+
+
+def _tcp_pair_with_faults():
+    p0, p1 = _free_ports(2)
+    peers = {0: ("127.0.0.1", p0), 1: ("127.0.0.1", p1)}
+    cfg2 = EngineConfig(n_groups=8, n_peers=2, log_slots=16, batch=4,
+                        max_submit=4)
+    tmpl2 = messages_template(cfg2)
+    faults = LinkFaults(2, seed=0)
+    accs = {i: InboxAccumulator(cfg2, tmpl2) for i in (0, 1)}
+    ts = {}
+    for i in (0, 1):
+        t = TcpTransport(i, dict(peers), cfg2, tmpl2,
+                         on_slice=accs[i].merge, faults=faults)
+        t.metrics = Metrics()   # before start(): senders capture it
+        ts[i] = t
+    for t in ts.values():
+        t.start()
+    return ts, accs, faults, cfg2, tmpl2
+
+
+def _tcp_rv(cfg2, tmpl2, term, src=0):
+    f = {name: np.zeros((cfg2.n_groups,) + trail, dt)
+         for name, (dt, trail) in tmpl2.items()}
+    f["rv_valid"][3] = True
+    f["rv_term"][3] = term
+    return codec.pack_slice(src, f, None)
+
+
+def _tcp_wait_term(acc, want, send, deadline_s=15):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        send()
+        time.sleep(0.05)
+        if acc.has_traffic:
+            arrays, _ = acc.drain()
+            terms = arrays["rv_term"][arrays["rv_valid"]]
+            if want in terms.tolist():
+                return True
+    return False
+
+
+def test_tcp_fault_drop_then_heal():
+    ts, accs, faults, cfg2, tmpl2 = _tcp_pair_with_faults()
+    try:
+        # Sanity: traffic flows, then a 100% drop regime silences the
+        # link WITHOUT killing the connection, and clearing it heals.
+        assert _tcp_wait_term(accs[1], 1,
+                              lambda: ts[0].send_slice(
+                                  1, _tcp_rv(cfg2, tmpl2, 1)))
+        faults.set_flaky(0, 1, drop_p=1.0)
+        for _ in range(10):
+            ts[0].send_slice(1, _tcp_rv(cfg2, tmpl2, 2))
+        time.sleep(0.5)
+        drained = accs[1].drain()[0] if accs[1].has_traffic else None
+        assert drained is None or 2 not in \
+            drained["rv_term"][drained["rv_valid"]].tolist()
+        dropped = ts[0].metrics["net_faults_dropped_total"]
+        assert dropped >= 1
+        faults.set_flaky(0, 1)               # heal mid-run
+        assert _tcp_wait_term(accs[1], 3,
+                              lambda: ts[0].send_slice(
+                                  1, _tcp_rv(cfg2, tmpl2, 3)))
+    finally:
+        for t in ts.values():
+            t.close()
+
+
+def test_tcp_asymmetric_partition_and_backoff_under_flapping():
+    """An injected one-way cut severs 0->1 only (1->0 keeps flowing),
+    senders ride the SAME jittered-exponential reconnect ladder a real
+    switch flap would (PR 12's backoff plane), and each heal of a
+    flapping partition resumes delivery."""
+    ts, accs, faults, cfg2, tmpl2 = _tcp_pair_with_faults()
+    try:
+        assert _tcp_wait_term(accs[1], 1,
+                              lambda: ts[0].send_slice(
+                                  1, _tcp_rv(cfg2, tmpl2, 1)))
+        base_rec = ts[0].metrics["reconnects_total"]
+        for flap, term in ((1, 10), (2, 11)):
+            faults.set_link(0, 1, False)     # 0->1 dead...
+            ts[0].send_slice(1, _tcp_rv(cfg2, tmpl2, 5))  # severs sender
+            assert _tcp_wait_term(accs[0], 20 + flap,
+                                  lambda: ts[1].send_slice(
+                                      0, _tcp_rv(cfg2, tmpl2, 20 + flap,
+                                                 src=1)))  # ...1->0 alive
+            deadline = time.time() + 10
+            while time.time() < deadline \
+                    and ts[0].metrics["reconnects_total"] <= base_rec:
+                time.sleep(0.05)
+            assert ts[0].metrics["reconnects_total"] > base_rec, \
+                "cut sender never entered the reconnect ladder"
+            faults.set_link(0, 1, True)      # heal: ladder reconnects
+            assert _tcp_wait_term(accs[1], term,
+                                  lambda: ts[0].send_slice(
+                                      1, _tcp_rv(cfg2, tmpl2, term)),
+                                  deadline_s=20)
+            base_rec = ts[0].metrics["reconnects_total"]
+        assert ts[0].metrics["net_faults_cut_total"] >= 1
+    finally:
+        for t in ts.values():
+            t.close()

@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Seeded chaos soak: every nemesis at once, judged by the checker.
+
+Drives a LocalCluster of full node runtimes (engine + WAL + machines +
+read plane) under a seeded mixed-nemesis timeline — asymmetric
+partitions, flaky links, crash/restart, clock stalls, slow storage,
+membership churn (testkit/chaos.py) — while seeded client threads
+run a register+list KV workload through recording stubs
+(testkit/history.py).  Afterwards the Wing & Gong checker
+(testkit/linz.py) must find the recorded history linearizable, and the
+run saves an auditable artifact under ``artifacts/`` embedding the
+canonical timeline (byte-for-byte reproducible from the seed), the
+applied-event audit, the transport fault counters, the raw history and
+the verdict.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/chaos_run.py --seed 7 --ticks 400
+    ... --no-lease        # strict ReadIndex instead of the lease path
+    ... --transport tcp   # real localhost sockets (slower, full plane)
+    ... --stale-reads     # inject the stale-read defect: MUST fail,
+                          # prints the minimal counterexample (checker
+                          # self-test; exits 0 when the bug is caught)
+
+Exit status: 0 = verdict matches expectation, 1 = it does not.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _artifact import PhaseLog  # noqa: E402  (tools/ sibling)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--peers", type=int, default=3)
+    ap.add_argument("--groups", type=int, default=3)
+    ap.add_argument("--ticks", type=int, default=300,
+                    help="timeline horizon (nemesis events stop here)")
+    ap.add_argument("--period", type=int, default=12,
+                    help="ticks between nemesis draws")
+    ap.add_argument("--clients", type=int, default=3)
+    ap.add_argument("--group", type=int, default=1,
+                    help="group the workload targets")
+    ap.add_argument("--no-lease", action="store_true",
+                    help="strict ReadIndex reads (read_lease=False)")
+    ap.add_argument("--transport", choices=("loopback", "tcp"),
+                    default="loopback")
+    ap.add_argument("--stale-reads", action="store_true",
+                    help="arm the KV machine's stale-read defect; the "
+                         "checker is then EXPECTED to fail")
+    ap.add_argument("--tick-sleep", type=float, default=0.002,
+                    help="conductor sleep per tick (yields to clients)")
+    ap.add_argument("--root", default=None,
+                    help="data dir (default: a fresh temp dir)")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from rafting_tpu.core.types import EngineConfig
+    from rafting_tpu.machine.kv_machine import KVMachineProvider
+    from rafting_tpu.testkit.chaos import (
+        ChaosConductor, KVWorkload, plan_chaos, timeline_json,
+    )
+    from rafting_tpu.testkit.harness import LocalCluster
+    from rafting_tpu.testkit.history import History
+    from rafting_tpu.testkit import linz
+
+    cfg = EngineConfig(n_groups=args.groups, n_peers=args.peers,
+                       log_slots=64, batch=8, max_submit=8,
+                       election_ticks=10, heartbeat_ticks=3,
+                       rpc_timeout_ticks=8,
+                       read_lease=not args.no_lease)
+    log = PhaseLog("chaos_soak", args.seed, {
+        "peers": args.peers, "groups": args.groups, "ticks": args.ticks,
+        "period": args.period, "clients": args.clients,
+        "lease": not args.no_lease, "transport": args.transport,
+        "stale_reads": args.stale_reads,
+    })
+
+    root = args.root or tempfile.mkdtemp(prefix="chaos_soak_")
+    events = plan_chaos(args.peers, args.ticks, seed=args.seed,
+                        period=args.period, churn_group=args.group)
+    tl = timeline_json(events)
+    log.phase("planned", events=len(events), timeline_bytes=len(tl))
+
+    cluster = LocalCluster(
+        cfg, root, seed=args.seed,
+        provider_factory=lambda i: KVMachineProvider(
+            os.path.join(root, f"node{i}", "kv"),
+            stale_reads=args.stale_reads),
+        transport=args.transport)
+    history = History()
+    try:
+        for g in range(args.groups):
+            cluster.wait_leader(g)
+        log.phase("cluster up", nodes=args.peers)
+
+        conductor = ChaosConductor(cluster, events)
+        load = KVWorkload(cluster, history, group=args.group,
+                          clients=args.clients, seed=args.seed)
+        load.start()
+        conductor.run(extra_ticks=40, tick_sleep=args.tick_sleep)
+        load.stop()
+        load.join(tick_fn=conductor.step)
+        conductor.finish()
+        log.phase("soak done", ticks=conductor.t,
+                  applied=len(conductor.applied),
+                  ops=load.ops_attempted, **history.counts())
+
+        verdict = linz.check(history)
+        print(verdict.render(), flush=True)
+        counters = cluster.faults.snapshot()["counters"]
+        log.phase("checked", ok=verdict.ok, keys=verdict.checked_keys,
+                  **{f"net_{k}": v for k, v in counters.items()})
+    finally:
+        cluster.close()
+
+    expected_ok = not args.stale_reads
+    success = verdict.ok == expected_ok
+    doc_extra = {
+        "timeline": json.loads(tl),
+        "timeline_canonical": tl,
+        "applied": conductor.applied,
+        "fault_counters": counters,
+        "history": history.to_json(),
+        "verdict": {
+            "ok": verdict.ok,
+            "key": verdict.key,
+            "counterexample": [op.describe()
+                               for op in verdict.counterexample],
+        },
+    }
+    log.config.update(doc_extra)
+    log.save("cpu", ok=success)
+    if not success:
+        print(f"FAIL: linearizable={verdict.ok}, expected "
+              f"{'ok' if expected_ok else 'a violation'}", flush=True)
+    return 0 if success else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
