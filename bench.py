@@ -609,6 +609,174 @@ def run_openloop_stage() -> None:
     assert ok, f"no-collapse property failed: {why}"
 
 
+def run_txn_stage() -> None:
+    """BENCH_TXN=1: the cross-group transaction stage replaces the
+    ladder — closed-loop 2-key Zipf bank transfers through the 2PC
+    plane (runtime/txn.py) on a durable 3-node cluster, A/B'd against
+    the SAME key traffic issued as two independent single-group writes
+    (the no-atomicity upper bound: what the cluster does when nobody
+    asks for cross-group all-or-nothing).  Emits txn/sec + abort rate
+    per scale point plus the atomicity-tax ratio vs that bound; the
+    tax is real and bounded — one transfer is five sequential quorum
+    commits (begin, 2x prepare, decide, finalize) against the bound's
+    two independent ones, so the honest ceiling is ~0.4x before lock
+    conflicts subtract their share.
+
+    Scale knobs: BENCH_TXN_GROUPS (comma ladder of total group counts,
+    coordinator + N-1 participants, default "3,5"), BENCH_TXN_CLIENTS
+    (default 8), BENCH_TXN_DUR (seconds per phase, default 4),
+    BENCH_TXN_ZIPF (account skew, default 1.0)."""
+    import itertools
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from rafting_tpu.api.stub import RaftStub
+    from rafting_tpu.core.types import EngineConfig
+    from rafting_tpu.machine.kv_machine import KVMachineProvider
+    from rafting_tpu.testkit.chaos import StubHost
+    from rafting_tpu.testkit.harness import LocalCluster
+    from rafting_tpu.testkit.openloop import OpenLoopSpec, gen_transfers
+
+    ladder = [int(x) for x in os.environ.get(
+        "BENCH_TXN_GROUPS", "3,5").split(",")]
+    clients = int(os.environ.get("BENCH_TXN_CLIENTS", "8"))
+    dur = float(os.environ.get("BENCH_TXN_DUR", "4"))
+    zipf = float(os.environ.get("BENCH_TXN_ZIPF", "1.0"))
+    n_accounts = 16
+
+    for n_groups in ladder:
+        participants = list(range(1, n_groups))
+        cfg = EngineConfig(n_groups=n_groups, n_peers=3, log_slots=64,
+                           batch=8, max_submit=8, election_ticks=10,
+                           heartbeat_ticks=3, rpc_timeout_ticks=8,
+                           read_lease=True)
+        root = tempfile.mkdtemp(prefix=f"txnbench-{n_groups}-")
+        cluster = LocalCluster(
+            cfg, root, seed=5,
+            provider_factory=lambda i: KVMachineProvider(
+                os.path.join(root, f"node{i}", "kv")))
+        stop = threading.Event()
+
+        def tick_loop():
+            while not stop.is_set():
+                for node in list(cluster.nodes.values()):
+                    node.tick()
+                time.sleep(0.002)
+
+        try:
+            for g in range(n_groups):
+                cluster.wait_leader(g)
+            threading.Thread(target=tick_loop, daemon=True).start()
+            hosts = [StubHost(cluster, c % cfg.n_peers)
+                     for c in range(clients)]
+            seeder = StubHost(cluster, 0)
+            for g in participants:
+                s = RaftStub(seeder, str(g), g, forward=True,
+                             forward_budget=10.0)
+                for a in range(n_accounts):
+                    s.execute(json.dumps({"op": "set", "k": f"acct{a}",
+                                          "v": 10_000}), timeout=10)
+            # One seeded plan feeds BOTH phases: same keys, same skew,
+            # same amounts — the A/B differs only in atomicity.
+            spec = OpenLoopSpec(rate=500.0, duration_s=dur * 8,
+                                n_tenants=4, n_groups=len(participants),
+                                seed=5)
+            plan = gen_transfers(spec, n_accounts=n_accounts,
+                                 account_zipf=zipf)
+
+            def phase(body) -> tuple:
+                idx = itertools.count()
+                outs = [{"ok": 0, "aborted": 0, "failed": 0}
+                        for _ in range(clients)]
+
+                def worker(c):
+                    host = hosts[c]
+                    parts = {g: RaftStub(host, str(g), g, forward=True,
+                                         forward_budget=8.0)
+                             for g in participants}
+                    coord = RaftStub(host, "0", 0, forward=True,
+                                     forward_budget=8.0)
+                    end = time.monotonic() + dur
+                    while time.monotonic() < end:
+                        step = plan[next(idx) % len(plan)]
+                        body(coord, parts, step, outs[c])
+                threads = [threading.Thread(target=worker, args=(c,))
+                           for c in range(clients)]
+                t0 = time.monotonic()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                el = time.monotonic() - t0
+                tot = {k: sum(o[k] for o in outs) for k in outs[0]}
+                return tot, el
+
+            def txn_body(coord, parts, step, out):
+                _t, _tn, src, dst, ka, kb, amt = step
+                sg, dg = participants[src], participants[dst]
+                try:
+                    r = (coord.txn(deadline_s=2.0)
+                         .transfer(parts[sg], ka, parts[dg], kb, amt)
+                         .execute(timeout=6.0))
+                    out["ok" if r.committed else "aborted"] += 1
+                except Exception:
+                    out["failed"] += 1
+
+            def write_body(coord, parts, step, out):
+                _t, _tn, src, dst, ka, kb, amt = step
+                sg, dg = participants[src], participants[dst]
+                try:
+                    parts[sg].execute(json.dumps(
+                        {"op": "incr", "k": ka, "v": -amt}), timeout=6.0)
+                    parts[dg].execute(json.dumps(
+                        {"op": "incr", "k": kb, "v": amt}), timeout=6.0)
+                    out["ok"] += 1
+                except Exception:
+                    out["failed"] += 1
+
+            txn_tot, txn_el = phase(txn_body)
+            wr_tot, wr_el = phase(write_body)
+        finally:
+            stop.set()
+            time.sleep(0.05)
+            cluster.close()
+            shutil.rmtree(root, ignore_errors=True)
+
+        attempted = txn_tot["ok"] + txn_tot["aborted"] + txn_tot["failed"]
+        txn_rate = txn_tot["ok"] / max(txn_el, 1e-9)
+        abort_rate = txn_tot["aborted"] / max(attempted, 1)
+        wr_rate = wr_tot["ok"] / max(wr_el, 1e-9)
+        ratio = txn_rate / max(wr_rate, 1e-9)
+        res = {
+            "platform": "cpu", "scale": n_groups,
+            "participants": len(participants), "clients": clients,
+            "duration_s": dur, "account_zipf": zipf,
+            "txn": {**txn_tot, "attempted": attempted,
+                    "elapsed_s": round(txn_el, 3)},
+            "independent_writes": {**wr_tot,
+                                   "elapsed_s": round(wr_el, 3)},
+            "txn_per_sec": round(txn_rate, 1),
+            "abort_rate": round(abort_rate, 4),
+            "independent_pairs_per_sec": round(wr_rate, 1),
+            "atomicity_tax": round(ratio, 3),
+        }
+        save_artifact(res, note="BENCH_TXN stage: cross-group 2PC "
+                                "transfers vs independent-writes bound")
+        emit({"metric": f"cross-group 2PC transfers/sec @{n_groups} "
+                        f"groups (1 coordinator + "
+                        f"{len(participants)} participants, 2-key "
+                        f"Zipf({zipf:g}) transfers, {clients} closed-"
+                        f"loop clients, durable 3-node cluster) "
+                        f"[abort rate {abort_rate:.1%}; independent-"
+                        f"writes bound {wr_rate:.0f} pairs/sec]",
+              "value": round(txn_rate, 1), "unit": "txn/sec",
+              "vs_baseline": round(ratio, 3)})
+        assert txn_tot["ok"] > 0, "txn stage committed nothing"
+
+
 def run_latency_ab() -> None:
     """BENCH_LAT=1: the latency-plane overhead A/B replaces the ladder —
     durable commits/sec through bench_runtime.run() with span sampling
@@ -840,6 +1008,11 @@ def main() -> None:
         # The overload stage replaces the ladder: open-loop rate sweep
         # with admission control on vs force-disabled (no-collapse A/B).
         run_openloop_stage()
+        return
+    if env_flag("BENCH_TXN"):
+        # The transaction stage replaces the ladder: cross-group 2PC
+        # transfers/sec + abort rate vs the independent-writes bound.
+        run_txn_stage()
         return
 
     profile_dir = os.environ.get("BENCH_PROFILE_DIR", "")

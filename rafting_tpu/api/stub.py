@@ -182,6 +182,28 @@ class RaftStub:
         return node.read_batch(self.lane, [enc(q) for q in queries],
                                tenant=self.tenant)
 
+    def txn(self, deadline_s: Optional[float] = None,
+            timeout: Optional[float] = None):
+        """Begin a CROSS-GROUP transaction with THIS stub's group as the
+        replicated 2PC coordinator (runtime/txn.py).  Returns a
+        :class:`~rafting_tpu.runtime.txn.TxnBuilder`: buffer ops against
+        participant stubs (``.set/.add/.incr/.delete/.transfer``), then
+        ``.execute()`` runs begin → prepare → decide → commit/abort on
+        the calling thread.  Every 2PC message rides this stub machinery
+        — leader forwarding, retry budgets, circuit breakers and
+        redirect caps included — and admission sheds at the TXN level
+        (a marked OverloadError before anything is written).
+
+        ``deadline_s`` bounds each participant's write-intent: past it,
+        participant leaders resolve the txn themselves by querying this
+        coordinator group's decided log (presumed abort).  ``timeout``
+        bounds the driver's whole flow (default: forward_budget)."""
+        from ..runtime.txn import TxnBuilder
+
+        if self._closed:
+            raise ObsoleteContextError(f"stub for {self.name!r} closed")
+        return TxnBuilder(self, deadline_s=deadline_s, timeout=timeout)
+
     def attach_history(self, history, proc: str) -> "RaftStub":
         """Record this stub's blocking calls into ``history`` as client
         process ``proc`` (testkit/history.py invoke/ok/fail/info; the

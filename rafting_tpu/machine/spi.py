@@ -70,6 +70,25 @@ class RaftMachine(Protocol):
       runtime resolves the read future with the ReadIndex itself (the
       linearization point), which callers can pair with their own state
       access.
+    * :meth:`expired_intents` (optional): 2PC participant hook.  A
+      machine that implements the transaction vocabulary (see
+      machine/kv_machine.py: ``txn_prepare`` buffers a write-intent
+      under key locks with a wall-clock deadline; ``txn_commit`` /
+      ``txn_abort`` finalize it — all replicated as ordinary log
+      payloads, so the machine needs NO extra durability) exposes
+      ``expired_intents(now) -> [{"txn", "coord", "deadline"}, ...]``
+      so the runtime's recovery sweep (runtime/txn.py, driven off the
+      tick loop on the leader) can find intents whose coordinator went
+      quiet and resolve them by querying the coordinator group's
+      replicated decision log.  Called on the tick thread (machine
+      single-writer); must not mutate state and should be O(1) when no
+      intents are live.  Machines without the hook simply never
+      participate in cross-group transactions.  Contract obligations
+      for implementers: prepare/commit/abort must be IDEMPOTENT
+      (recovery replays them), commit/abort for an unknown txn must be
+      safe no-ops, a finalized txn must never re-lock, and buffered
+      intents must be INVISIBLE to both read paths (apply-side reads
+      and this SPI's :meth:`read`) until commit.
     """
 
     applies_empty: bool = False

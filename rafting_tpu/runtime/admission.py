@@ -99,6 +99,8 @@ class AdmissionController:
         self.shed = 0
         self.shed_tenant = 0       # subset of shed: over-share tenants
         self.expired = 0           # late sheds: aged out of the queue
+        self.txn_admitted = 0      # whole-transaction decisions
+        self.txn_shed = 0          # (runtime/txn.py admit_txn)
         # Tenant admission accounting: current window accumulates, the
         # LAST completed window is what fairness decisions read (stable
         # within a window).
@@ -200,6 +202,26 @@ class AdmissionController:
             self._tenant_cur[tenant] = self._tenant_cur.get(tenant, 0) + n
         return None
 
+    def admit_txn(self, n: int = 1,
+                  tenant: Optional[str] = None) -> Optional[float]:
+        """Whole-TRANSACTION admission (the 2PC plane, runtime/txn.py):
+        one decision covers all ``n`` entries the transaction will write
+        across every participant group, taken BEFORE txn_begin is
+        submitted.  This is the txn-level shed the overload plane
+        requires — refusing here costs the cluster nothing (no id
+        allocated, no intent buffered, retry is trivially safe), whereas
+        refusing one participant's PREPARE mid-flight strands the other
+        participants' intents until the abort fan-out or the deadline
+        sweep reclaims them.  Same control law and hint as :meth:`admit`;
+        accounted separately so /healthz and the open-loop proof can
+        show refusals happen at the txn boundary."""
+        ra = self.admit(n, tenant)
+        if ra is None:
+            self.txn_admitted += 1
+        else:
+            self.txn_shed += 1
+        return ra
+
     def retry_after(self) -> float:
         """Server-issued backoff hint: at least one observation window —
         retrying sooner cannot see a different decision — stretched with
@@ -270,6 +292,8 @@ class AdmissionController:
             "shed_total": self.shed,
             "shed_tenant_total": self.shed_tenant,
             "expired_total": self.expired,
+            "txn_admitted_total": self.txn_admitted,
+            "txn_shed_total": self.txn_shed,
         }
 
 

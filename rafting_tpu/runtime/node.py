@@ -65,6 +65,7 @@ from ..api.anomaly import (
     UnavailableError, as_refusal,
 )
 from .admission import admission_from_env
+from .txn import txn_plane_from_env
 from ..log.wal import WalNoSpace, WalSyncError
 from ..utils.latency import (
     ACKED, FSYNCED, OFFERED, SENT, SERVED, STAGED, tracer_from_env,
@@ -473,6 +474,11 @@ class RaftNode:
         self.admission = admission_from_env(seed=seed ^ node_id)
         self._adm_delay: Optional[float] = None  # this tick's sojourn sample
         self._adm_fold = [0, 0, 0, 0]  # counters folded into metrics
+        # Cross-group transaction plane (runtime/txn.py): the driver
+        # gate client threads check before txn_begin (txn-level shed),
+        # and the deadline-expiry recovery sweep the tick loop drives
+        # for groups this node leads.
+        self.txn = txn_plane_from_env()
 
         # Linearizable read plane (ReadIndex + lease, core/step.py phase
         # 8b): the host-side FIFO mirror of the device's rq_* lanes.  A
@@ -659,6 +665,13 @@ class RaftNode:
             self.metrics[_c] += 0
         self.metrics.gauge("admission_level", 0.0)
         self.metrics.gauge("admission_shedding", 0)
+        # Txn-plane counters rendered from boot (same contract as the
+        # admission counters: a scraper sees the series at 0, not a gap).
+        for _name in ("txn_committed", "txn_aborted", "txn_refused",
+                      "txn_unknown", "txn_resolved_commit",
+                      "txn_resolved_abort", "txn_resolve_retry"):
+            self.metrics[_name] += 0
+        self.metrics.gauge("txn_inflight", 0.0)
         # The transport reports its own health (reconnects_total) into
         # the node registry; set before start() spawns sender threads.
         self.transport.metrics = self.metrics
@@ -762,6 +775,7 @@ class RaftNode:
             doc["wal_stripes"] = [
                 dict(s, stripe=i) for i, s in enumerate(per())]
         doc["worker_util"] = list(self._worker_util)
+        doc["txn_plane"] = self.txn.snapshot()
         return doc
 
     def close(self) -> None:
@@ -1245,6 +1259,11 @@ class RaftNode:
         self.metrics.observe("tick_latency_s",
                              time.perf_counter() - _tick_t0)
         self._admission_tick(time.perf_counter() - _tick_t0)
+        # Txn plane: fold driver/resolver counters and (every
+        # sweep_every ticks) resolve expired write-intents on groups
+        # this node leads (runtime/txn.py — coordinator timeouts are
+        # driven off this tick loop, not off any client thread).
+        self.txn.tick(self)
         if self._lat is not None:
             # Merge retired spans from every thread's ring into the
             # shared histograms — tick thread only, so the registry

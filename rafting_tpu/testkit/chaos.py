@@ -44,13 +44,14 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..api.anomaly import UnavailableError, as_refusal
+from ..api.anomaly import UnavailableError, as_refusal, is_refusal
 from .harness import LocalCluster, free_ports
 from .history import History
 
 __all__ = [
     "ChaosEvent", "plan_chaos", "timeline_json", "ChaosConductor",
-    "StubHost", "make_recording_stub", "KVWorkload", "ProcCluster",
+    "StubHost", "make_recording_stub", "KVWorkload", "TransferWorkload",
+    "ProcCluster",
 ]
 
 
@@ -435,6 +436,121 @@ class KVWorkload:
             self.ops_attempted += 1
             # Brief jittered pause: yields the GIL to the tick thread
             # (1-vCPU hosts) and decorrelates the clients.
+            time.sleep(0.002 + rng.random() * 0.006)
+
+
+class TransferWorkload:
+    """N client threads driving seeded cross-group bank transfers through
+    the 2PC plane (runtime/txn.py) while the conductor ticks concurrently.
+
+    Each transfer moves ``amount`` between two accounts in two DIFFERENT
+    Raft groups via ``stub.txn().transfer(...)``.  Outcomes are recorded
+    as kind-``t`` ops in the history — linz.py refuses those by design;
+    the judgment for this workload is check_transfer_atomicity over the
+    converged machines, plus balance conservation (transfers are
+    zero-sum, so the acct* total never moves).
+
+    History classification mirrors StubRecorder: a returned decision
+    (commit OR abort) is ``ok`` — both are definite outcomes; a MARKED
+    refusal (admission txn-shed, node down) is ``fail`` — the plane
+    proves no PREPARE was sent; anything else is ``info`` — the txn is
+    in doubt and the deadline sweep owns its resolution."""
+
+    def __init__(self, cluster: LocalCluster, history: History, *,
+                 coord_group: int = 0, groups: Sequence[int] = (1, 2),
+                 clients: int = 3, seed: int = 0, accounts: int = 8,
+                 max_amount: int = 5, deadline_s: float = 4.0,
+                 op_timeout: float = 8.0):
+        assert len(groups) >= 2, "transfers need two distinct groups"
+        assert coord_group not in groups, \
+            "coordinator group must not double as a participant"
+        self.cluster = cluster
+        self.history = history
+        self.coord_group = coord_group
+        self.groups = list(groups)
+        self.seed = seed
+        self.accounts = accounts
+        self.max_amount = max_amount
+        self.deadline_s = deadline_s
+        self.op_timeout = op_timeout
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._client, args=(c,),
+                             name=f"xfer-client-{c}", daemon=True)
+            for c in range(clients)]
+        self.attempted = 0
+        self.committed = 0
+        self.aborted = 0
+        self.refused = 0
+        self.unknown = 0
+
+    def start(self) -> None:
+        for t in self._threads:
+            t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def join(self, tick_fn=None, timeout: float = 60.0) -> None:
+        """Join the client threads while ``tick_fn`` keeps the cluster
+        ticking (a blocked 2PC driver needs the coordinator and both
+        participants to keep committing)."""
+        deadline = time.monotonic() + timeout
+        while any(t.is_alive() for t in self._threads):
+            if tick_fn is not None:
+                tick_fn()
+            time.sleep(0.01)
+            if time.monotonic() > deadline:
+                break
+        for t in self._threads:
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
+
+    def counts(self) -> dict:
+        return {"attempted": self.attempted, "committed": self.committed,
+                "aborted": self.aborted, "refused": self.refused,
+                "unknown": self.unknown}
+
+    def _client(self, c: int) -> None:
+        rng = Random(self.seed * 7841 + c)
+        n_peers = self.cluster.cfg.n_peers
+        host = StubHost(self.cluster, c % n_peers)
+        from ..api.stub import RaftStub
+        coord = RaftStub(host, name=str(self.coord_group),
+                         lane=self.coord_group, forward=True,
+                         forward_budget=self.op_timeout)
+        parts = {g: RaftStub(host, name=str(g), lane=g, forward=True,
+                             forward_budget=self.op_timeout)
+                 for g in self.groups}
+        while not self._stop.is_set():
+            sg = self.groups[rng.randrange(len(self.groups))]
+            dg = sg
+            while dg == sg:
+                dg = self.groups[rng.randrange(len(self.groups))]
+            sk = f"acct{rng.randrange(self.accounts)}"
+            dk = f"acct{rng.randrange(self.accounts)}"
+            amt = 1 + rng.randrange(self.max_amount)
+            op_id = self.history.invoke(
+                f"x{c}", "t", f"{sg}/{sk}->{dg}/{dk}", amt)
+            self.attempted += 1
+            try:
+                r = (coord.txn(deadline_s=self.deadline_s)
+                     .transfer(parts[sg], sk, parts[dg], dk, amt)
+                     .execute(timeout=self.op_timeout))
+            except Exception as e:
+                if is_refusal(e):
+                    self.refused += 1
+                    self.history.fail(op_id, type(e).__name__)
+                else:
+                    self.unknown += 1
+                    self.history.info(op_id, type(e).__name__)
+            else:
+                if r.committed:
+                    self.committed += 1
+                else:
+                    self.aborted += 1
+                self.history.ok(op_id, {"txn": r.txn,
+                                        "decision": r.decision})
+            # Yield to the tick thread and decorrelate the clients.
             time.sleep(0.002 + rng.random() * 0.006)
 
 

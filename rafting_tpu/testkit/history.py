@@ -46,8 +46,11 @@ from ..api.anomaly import is_refusal
 __all__ = ["Op", "History", "StubRecorder"]
 
 # Op kinds: "w" register write (KV set), "a" list append (KV add),
-# "r" read (KV get).
-_KINDS = ("w", "a", "r")
+# "r" read (KV get), "t" cross-group transaction (runtime/txn.py — a
+# multi-key op the per-key Wing & Gong checker must NOT judge; linz.py
+# refuses "t" ops and routes callers to the transfer invariant,
+# testkit/invariants.py check_transfer_atomicity).
+_KINDS = ("w", "a", "r", "t")
 
 
 @dataclass
@@ -67,7 +70,8 @@ class Op:
     def describe(self) -> str:
         what = {"w": f"w {self.key}={self.value!r}",
                 "a": f"a {self.key}+={self.value!r}",
-                "r": f"r {self.key}"}[self.kind]
+                "r": f"r {self.key}",
+                "t": f"t {self.key} {self.value!r}"}[self.kind]
         end = (f"{self.status}@{int(self.resp_seq)}"
                if math.isfinite(self.resp_seq) else f"{self.status}@∞")
         got = f" -> {self.result!r}" if self.status == "ok" else \

@@ -159,7 +159,16 @@ def _shrink(ops: List[Op], initial: Any = None) -> List[Op]:
 
 def check(history, initial: Any = None) -> LinzResult:
     """Check a whole history (or a prepared per-key dict / op list),
-    compositionally per key."""
+    compositionally per key.
+
+    SINGLE-KEY OPS ONLY: per-key composition is sound exactly because
+    each op touches one key (Herlihy & Wing locality).  A cross-group
+    TRANSACTION (op kind ``t``, runtime/txn.py) touches several keys
+    atomically — splitting it per key would silently judge each leg as
+    an independent single-key op and certify histories where atomicity
+    was in fact violated.  Such histories must go to the transfer
+    invariant instead (testkit/invariants.py check_transfer_atomicity);
+    this guard makes the mis-route loud rather than silently unsound."""
     if isinstance(history, History):
         keys = history.by_key()
     elif isinstance(history, dict):
@@ -168,6 +177,14 @@ def check(history, initial: Any = None) -> LinzResult:
         keys = {}
         for op in history:
             keys.setdefault(op.key, []).append(op)
+    for ops in keys.values():
+        for o in ops:
+            if o.kind not in ("w", "a", "r"):
+                raise ValueError(
+                    f"linz.check got a multi-key op (kind {o.kind!r}, "
+                    f"op id {o.id}): per-key composition is unsound for "
+                    f"transactions — route txn histories to "
+                    f"testkit.invariants.check_transfer_atomicity")
     n_ops = sum(len(v) for v in keys.values())
     counts: Dict[str, int] = {"ok": 0, "fail": 0, "info": 0}
     for ops in keys.values():

@@ -44,8 +44,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 __all__ = [
-    "OpenLoopSpec", "OpenLoopResult", "gen_schedule", "run_open_loop",
-    "zipf_weights",
+    "OpenLoopSpec", "OpenLoopResult", "Transfer", "gen_schedule",
+    "gen_transfers", "run_open_loop", "zipf_weights",
 ]
 
 
@@ -126,6 +126,42 @@ def gen_schedule(spec: OpenLoopSpec) -> List[Arrival]:
             ten = int(np.searchsorted(t_cum, rng.random()))
             grp = int(np.searchsorted(g_cum, rng.random()))
             out.append((t, f"tenant-{ten}", grp))
+    return out
+
+
+# One scheduled bank transfer: (t_offset_s, tenant, src_group_rank,
+# dst_group_rank, src_key, dst_key, amount) — the 2-key txn workload
+# for the cross-group transaction plane (runtime/txn.py).
+Transfer = Tuple[float, str, int, int, str, str, int]
+
+
+def gen_transfers(spec: OpenLoopSpec, n_accounts: int = 64,
+                  account_zipf: float = 1.0,
+                  max_amount: int = 5) -> List[Transfer]:
+    """Materialize a seeded transfers-between-accounts schedule on top
+    of :func:`gen_schedule`'s arrival law: each arrival becomes a 2-key
+    transfer debiting ``src_key`` on the arrival's (Zipf-hot) group and
+    crediting ``dst_key`` on a different group, with BOTH account keys
+    drawn Zipf over ``n_accounts`` — hot accounts contend, which is
+    what gives the 2PC plane real lock conflicts to abort on.  Amounts
+    are uniform in [1, max_amount].  Deterministic in ``spec.seed``;
+    the sum of all balances is invariant under any subset of these
+    transfers applied atomically (testkit/invariants.py judges that)."""
+    sched = gen_schedule(spec)
+    rng = random.Random(spec.seed ^ 0x72A45)
+    a_cum = np.cumsum(zipf_weights(n_accounts, account_zipf))
+    out: List[Transfer] = []
+    for t, tenant, src in sched:
+        if spec.n_groups > 1:
+            dst = rng.randrange(spec.n_groups - 1)
+            if dst >= src:
+                dst += 1
+        else:
+            dst = src
+        a = int(np.searchsorted(a_cum, rng.random()))
+        b = int(np.searchsorted(a_cum, rng.random()))
+        out.append((t, tenant, src, dst, f"acct{a}", f"acct{b}",
+                    1 + rng.randrange(max_amount)))
     return out
 
 

@@ -67,6 +67,21 @@ PHASE_PAIRS = (
     ("apply_ack", APPLIED, ACKED),
 )
 
+# Transaction phase indices (TxnSpan.t slots) — the 2PC lifecycle the
+# txn plane (runtime/txn.py) stamps per sampled transaction.
+T_BEGIN, T_PREPARED, T_DECIDED, T_APPLIED, T_ACKED = range(5)
+
+TXN_PHASE_NAMES = ("begin", "prepared", "decided", "applied", "acked")
+
+TXN_PHASE_PAIRS = (
+    ("begin_prepare", T_BEGIN, T_PREPARED),    # begin replicated + all
+    #                                            participant PREPAREs acked
+    ("prepare_decide", T_PREPARED, T_DECIDED),  # decision replicated in
+    #                                            the coordinator group
+    ("decide_apply", T_DECIDED, T_APPLIED),    # commit/abort fan-out
+    ("apply_ack", T_APPLIED, T_ACKED),         # result handed to caller
+)
+
 
 class Span:
     """One sampled entry's lifecycle record.  Mutated by whichever
@@ -108,6 +123,39 @@ class Span:
                 "outcome": self.outcome or "in-flight", "phases": phases}
 
 
+class TxnSpan:
+    """One sampled cross-group transaction's 2PC lifecycle record
+    (begin → prepared → decided → applied → acked).  Stamped by the
+    driving client thread only (runtime/txn.py runs the whole 2PC flow
+    on the caller's thread), retired into that thread's ring like any
+    Span — the tick thread folds it at harvest.  Outcomes: ``commit`` /
+    ``abort`` (clean decisions — both contribute latency samples),
+    ``refused`` (txn-level admission shed, pre-PREPARE), ``unknown``
+    (coordinator unreachable mid-flight; resolved later by recovery)."""
+
+    __slots__ = ("seq", "tid", "parts", "t", "outcome", "tr")
+
+    def __init__(self, seq: int):
+        self.seq = seq
+        self.tid = ""
+        self.parts = 0            # participant count
+        self.t = [0.0] * 5
+        self.outcome: Optional[str] = None
+        self.tr: Optional["LatencyTracer"] = None
+
+    def mark(self, phase: int) -> None:
+        if self.t[phase] == 0.0:
+            self.t[phase] = time.perf_counter()
+
+    def to_dict(self) -> dict:
+        t0 = self.t[T_BEGIN]
+        phases = {TXN_PHASE_NAMES[i]: round(self.t[i] - t0, 9)
+                  for i in range(1, 5) if self.t[i] > 0.0}
+        return {"seq": self.seq, "kind": "t", "txn": self.tid,
+                "parts": self.parts,
+                "outcome": self.outcome or "in-flight", "phases": phases}
+
+
 class LatencyTracer:
     """Sampler + span bookkeeping + harvest for one node.
 
@@ -128,6 +176,9 @@ class LatencyTracer:
         self.max_live = int(max_live)
         self._seq_w = 0           # guarded by the node's submit lock
         self._seq_r = 0           # guarded by the node's read lock
+        self._seq_t = 0           # txn drivers run on arbitrary client
+        self._seq_t_lock = threading.Lock()   # threads: own tiny lock
+        self._txn_seen = False    # tick thread: any TxnSpan harvested yet
         self._live = 0
         self._live_lock = threading.Lock()
         self._rings_lock = threading.Lock()
@@ -160,6 +211,12 @@ class LatencyTracer:
         self._seq_r = s + n
         return s
 
+    def next_seq_t(self) -> int:
+        with self._seq_t_lock:
+            s = self._seq_t
+            self._seq_t = s + 1
+        return s
+
     # -- span lifecycle -------------------------------------------------
     def make_span(self, seq: int, kind: str, k: int) -> Optional[Span]:
         """Admit a sampled candidate (bounded by ``max_live``)."""
@@ -172,6 +229,20 @@ class LatencyTracer:
         sp = Span(seq, kind, k)
         sp.tr = self
         sp.mark(SUBMITTED)
+        return sp
+
+    def make_txn_span(self, seq: int) -> Optional[TxnSpan]:
+        """Admit a sampled txn candidate (same ``max_live`` bound and
+        overflow accounting as entry spans)."""
+        with self._live_lock:
+            if self._live >= self.max_live:
+                self.counts["overflow"] += 1
+                return None
+            self._live += 1
+            self.counts["sampled"] += 1
+        sp = TxnSpan(seq)
+        sp.tr = self
+        sp.mark(T_BEGIN)
         return sp
 
     def _ring(self) -> deque:
@@ -231,6 +302,21 @@ class LatencyTracer:
                     observe("lat_client_read_s" if sp[1]
                             else "lat_client_execute_s", sp[0])
                     continue
+                if sp.__class__ is TxnSpan:   # 2PC lifecycle sample
+                    self._txn_seen = True
+                    self.recent.append(sp)
+                    key = "txn_" + (sp.outcome or "unknown")
+                    c[key] = c.get(key, 0) + 1
+                    if sp.outcome in ("commit", "abort"):
+                        t = sp.t
+                        for name, a, b in TXN_PHASE_PAIRS:
+                            if t[a] > 0.0 and t[b] > 0.0:
+                                observe(f"lat_txn_{name}_s",
+                                        max(0.0, t[b] - t[a]))
+                        if t[T_ACKED] > 0.0:
+                            observe("lat_txn_e2e_s",
+                                    t[T_ACKED] - t[T_BEGIN])
+                    continue
                 self.recent.append(sp)
                 if sp.outcome != "ok":
                     c[sp.outcome] = c.get(sp.outcome, 0) + 1
@@ -265,6 +351,15 @@ class LatencyTracer:
         metrics["lat_spans_unknown"] = c["unknown"]
         metrics["lat_spans_refused"] = c["refused"]
         metrics["lat_span_overflow"] = c["overflow"]
+        if self._txn_seen:
+            th = metrics.histogram("lat_txn_e2e_s")
+            metrics.gauge("lat_txn_e2e_p50_s", th.quantile(0.5))
+            metrics.gauge("lat_txn_e2e_p99_s", th.quantile(0.99))
+            metrics.gauge("lat_txn_e2e_p999_s", th.quantile(0.999))
+            nc = c.get("txn_commit", 0)
+            na = c.get("txn_abort", 0)
+            metrics.gauge("lat_txn_abort_ratio",
+                          na / (nc + na) if (nc + na) else 0.0)
 
     # -- views -----------------------------------------------------------
     def snapshot(self, metrics) -> dict:
@@ -292,6 +387,23 @@ class LatencyTracer:
             h = metrics._histograms.get(key)
             if h is not None and h.n:
                 doc[key[:-2]] = h.summary() | {"p999": h.quantile(0.999)}
+        if self._txn_seen:
+            txn_phases = {}
+            for name, _a, _b in TXN_PHASE_PAIRS:
+                h = metrics._histograms.get(f"lat_txn_{name}_s")
+                if h is not None and h.n:
+                    txn_phases[name] = h.summary() | \
+                        {"p999": h.quantile(0.999)}
+            c = self.counts
+            txn = {"phases": txn_phases,
+                   "counts": {k: v for k, v in c.items()
+                              if k.startswith("txn_")},
+                   "abort_ratio": metrics._gauges.get(
+                       "lat_txn_abort_ratio", 0.0)}
+            h = metrics._histograms.get("lat_txn_e2e_s")
+            if h is not None and h.n:
+                txn["e2e"] = h.summary() | {"p999": h.quantile(0.999)}
+            doc["txn"] = txn
         return doc
 
 
