@@ -835,6 +835,65 @@ def run_latency_ab() -> None:
         f"{off_cps:.0f} commits/sec")
 
 
+def run_heat_ab() -> None:
+    """BENCH_HEAT=1: the fleet-attribution overhead A/B replaces the
+    ladder — durable commits/sec with the FULL attribution plane on
+    (heat lanes compiled in + 1/64 span sampling + cross-node hop
+    tracing) vs everything off, at the same scale (default 100k groups,
+    BENCH_HEAT_SCALE overrides), in one process so all runs share jit
+    caches.  Mirrored ABBA order (off, on, on, off) for the same
+    drift-cancellation reason as BENCH_LAT.  Asserts the attributed
+    pair keeps >98% of the bare pair's throughput: the heat lanes are
+    four branchless [G] adds folded into the existing scan, the drain
+    is one vectorized delta per tick, and hop records ride existing
+    flushes — observation must stay cheaper than 2%."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import bench_runtime
+    scale = int(os.environ.get("BENCH_HEAT_SCALE", "100000"))
+    off1 = bench_runtime.run(n_groups=scale, lat_sample=0, heat=False,
+                             hops=False)
+    on1 = bench_runtime.run(n_groups=scale, lat_sample=64, heat=True,
+                            hops=True)
+    on2 = bench_runtime.run(n_groups=scale, lat_sample=64, heat=True,
+                            hops=True)
+    off2 = bench_runtime.run(n_groups=scale, lat_sample=0, heat=False,
+                             hops=False)
+    assert on1["heat"]["enabled"] and not off1["heat"]["enabled"], \
+        "A/B heat pins did not take"
+    on_cps = (on1["value"] + on2["value"]) / 2
+    off_cps = (off1["value"] + off2["value"]) / 2
+    overhead = 1.0 - on_cps / max(off_cps, 1)
+    res = {
+        "scale": scale,
+        "platform": "cpu",
+        "heat_overhead": round(overhead, 4),
+        "attributed_commits_per_sec": round(on_cps),
+        "bare_commits_per_sec": round(off_cps),
+        "order": "ABBA (off, on, on, off)",
+        "active_set": on1["heat"].get("active_set"),
+        "attributed": [on1, on2],
+        "bare": [off1, off2],
+    }
+    save_artifact(res, note="BENCH_HEAT stage: fleet-attribution "
+                            "overhead A/B")
+    emit({
+        "metric": f"fleet-attribution overhead @{scale // 1000}k groups "
+                  f"(heat lanes + 1/64 sampling + hop tracing vs all "
+                  f"off, durable runtime, loopback)",
+        "value": round(overhead * 100, 2),
+        "unit": "% durable commits/sec regression (target <2%)",
+        "vs_baseline": None,
+        "attributed_commits_per_sec": round(on_cps),
+        "bare_commits_per_sec": round(off_cps),
+        "active_set": on1["heat"].get("active_set"),
+    })
+    assert overhead < 0.02, (
+        f"attribution plane costs {overhead * 100:.2f}% durable "
+        f"throughput (budget: 2%) — attributed {on_cps:.0f} vs bare "
+        f"{off_cps:.0f} commits/sec")
+
+
 def headline(res: dict, fallback: str = "", tuned: bool = False,
              extra_note: str = "") -> dict:
     plat = res["platform"]
@@ -1003,6 +1062,12 @@ def main() -> None:
         # The latency-plane overhead A/B replaces the ladder: durable
         # commits/sec with 1/64 span sampling vs off (<2% budget).
         run_latency_ab()
+        return
+    if env_flag("BENCH_HEAT"):
+        # The fleet-attribution overhead A/B replaces the ladder:
+        # durable commits/sec with heat lanes + sampling + hop tracing
+        # vs all off (<2% budget).
+        run_heat_ab()
         return
     if env_flag("BENCH_OPENLOOP"):
         # The overload stage replaces the ladder: open-loop rate sweep

@@ -53,7 +53,8 @@ def _shape(n_groups: int):
 
 def run(n_groups: int = 1024, rounds: int = 0, burst_n: int = 0,
         transport: str = "loopback", pipeline=None,
-        host_workers=None, native=None, lat_sample=None) -> dict:
+        host_workers=None, native=None, lat_sample=None,
+        heat=None, hops=None) -> dict:
     """``pipeline``: True/False forces the durable pipeline on/off for
     every node; None uses the runtime default (RAFT_PIPELINE env if set,
     else on only for accelerator engine backends — see RaftNode).
@@ -65,7 +66,12 @@ def run(n_groups: int = 1024, rounds: int = 0, burst_n: int = 0,
     ``lat_sample``: pins RAFT_LAT_SAMPLE (1/N span sampling; 0 disables
     the latency plane entirely) for the run; None = env default.  When
     the plane is on, the result carries per-entry commit-path latency
-    distributions (e2e + per-phase), not just throughput."""
+    distributions (e2e + per-phase), not just throughput.
+    ``heat``: True/False compiles the per-group heat lanes
+    (EngineConfig.heat — device activity counters + host heat registry)
+    in/out; None = off (the config default).
+    ``hops``: True/False pins RAFT_HOP_TRACE (cross-node hop tracing)
+    on/off for the run; None = env default (on)."""
     from rafting_tpu.core.types import EngineConfig, LEADER
     from rafting_tpu.testkit.fixtures import NullProvider
     from rafting_tpu.testkit.harness import LocalCluster
@@ -86,13 +92,16 @@ def run(n_groups: int = 1024, rounds: int = 0, burst_n: int = 0,
         n_groups=n_groups, n_peers=3, log_slots=slots,
         batch=int(os.environ.get("BENCH_RT_BATCH", "32")),
         max_submit=int(os.environ.get("BENCH_RT_SUBMIT", "32")),
-        election_ticks=10, heartbeat_ticks=3, rpc_timeout_ticks=8)
+        election_ticks=10, heartbeat_ticks=3, rpc_timeout_ticks=8,
+        heat=bool(heat))
     root = tempfile.mkdtemp(prefix="bench-runtime-")
     pins = {}
     if native is not None:
         pins["RAFT_NATIVE_HOST"] = "1" if native else "0"
     if lat_sample is not None:
         pins["RAFT_LAT_SAMPLE"] = str(lat_sample)
+    if hops is not None:
+        pins["RAFT_HOP_TRACE"] = "1" if hops else "0"
     env_prev = {k: os.environ.get(k) for k in pins}
     os.environ.update(pins)
     try:
@@ -238,6 +247,10 @@ def run(n_groups: int = 1024, rounds: int = 0, burst_n: int = 0,
             "tick_stages_mean_s": stages,
             "applies_per_sec_windowed": round(applies_ps),
             "latency": latency,
+            "heat": ({"enabled": True,
+                      "active_set": slow.heatmap_snapshot(8)
+                      .get("active_set")}
+                     if slow.heat is not None else {"enabled": False}),
         }
     finally:
         c.close()

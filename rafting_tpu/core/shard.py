@@ -22,8 +22,8 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
 
 from .types import (
-    EngineConfig, FaultSchedule, HostInbox, LogState, Messages, RaftState,
-    StepInfo, TraceState,
+    EngineConfig, FaultSchedule, HeatState, HostInbox, LogState, Messages,
+    RaftState, StepInfo, TraceState,
 )
 
 # RaftState fields with no group axis: per-node scalars and the PRNG key.
@@ -34,13 +34,14 @@ _NODE_GROUP = PS("node", "group")          # [N, G, ...] — trailing dims repli
 _NODE_PEER_GROUP = PS("node", None, "group")  # [N, P, G, ...] message planes
 
 
-def state_pspecs(trace: bool = False) -> RaftState:
+def state_pspecs(trace: bool = False, heat: bool = False) -> RaftState:
     """A RaftState-shaped pytree of PartitionSpecs for stacked [N, ...] state.
 
     ``trace`` must match whether the state carries flight-recorder lanes
     (cfg.trace_depth > 0): a None subtree in the state needs a None in the
     spec tree, and recorder lanes are [N, G, D] group-major like every
-    per-group lane."""
+    per-group lane.  ``heat`` likewise matches cfg.heat — heat lanes are
+    plain [N, G] group-major counters."""
     kw = {f.name: _NODE_GROUP for f in dataclasses.fields(RaftState)}
     for name in _STATE_NODE_ONLY:
         kw[name] = _NODE
@@ -50,6 +51,9 @@ def state_pspecs(trace: bool = False) -> RaftState:
     kw["trace"] = TraceState(
         tick=_NODE_GROUP, kind=_NODE_GROUP, term=_NODE_GROUP,
         aux=_NODE_GROUP, n=_NODE_GROUP) if trace else None
+    kw["heat"] = HeatState(
+        appended=_NODE_GROUP, sent=_NODE_GROUP, commits=_NODE_GROUP,
+        reads=_NODE_GROUP) if heat else None
     return RaftState(**kw)
 
 
@@ -120,6 +124,9 @@ def validate_cluster_shapes(cfg: EngineConfig, states: RaftState,
     if states.trace is not None:
         assert states.trace.tick.shape[1] == G, states.trace.tick.shape
         assert states.trace.n.shape[1:] == (G,), states.trace.n.shape
+    if states.heat is not None:
+        assert states.heat.appended.shape[1:] == (G,), \
+            states.heat.appended.shape
     assert inflight.ae_valid.ndim == 3 and inflight.ae_valid.shape[2] == G, \
         inflight.ae_valid.shape
     assert info.commit.shape[1] == G, info.commit.shape
@@ -143,7 +150,8 @@ def shard_cluster(mesh: Mesh, cfg: EngineConfig, states: RaftState,
             lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
             tree, specs)
 
-    states = put(states, state_pspecs(trace=states.trace is not None))
+    states = put(states, state_pspecs(trace=states.trace is not None,
+                                      heat=states.heat is not None))
     inflight = put(inflight, messages_pspecs())
     info = put(info, info_pspecs())
     conn = jax.device_put(conn, NamedSharding(mesh, CONN_PSPEC))

@@ -213,7 +213,8 @@ def run_cluster_ticks_blocked(cfg: EngineConfig, n_ticks: int,
     cfg_blk = dataclasses.replace(cfg, n_groups=gb)
 
     st_specs, msg_specs, inf_specs = (
-        state_pspecs(trace=states.trace is not None), messages_pspecs(),
+        state_pspecs(trace=states.trace is not None,
+                     heat=states.heat is not None), messages_pspecs(),
         info_pspecs())
     states_b = _to_blocks(states, st_specs, nb, gb)
     inflight_b = _to_blocks(inflight, msg_specs, nb, gb)

@@ -1146,6 +1146,27 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
             n=trace.n + n_new,
         )
 
+    # ---- heat lanes (cfg.heat) --------------------------------------------
+    # Cumulative per-group activity counters for the host-side heat
+    # registry: entries appended, RPCs emitted (all 7 kinds), commit
+    # advance, reads served.  Branchless masked adds over lanes already
+    # live at this point — the tick's outbox valid planes and the
+    # append/commit/read results — so the extra work is a handful of [G]
+    # sums; when off the subtree is None and nothing here traces.
+    heat = s.heat
+    if cfg.heat:
+        sent_n = (out_ae_valid.astype(I32) + out_aer_valid.astype(I32)
+                  + out_rv_valid.astype(I32) + out_rvr_valid.astype(I32)
+                  + out_is_valid.astype(I32) + out_isr_valid.astype(I32)
+                  + out_tn_valid.astype(I32)).sum(axis=0)
+        appended_n = jnp.where(app_to > 0, app_to - app_from + 1, 0)
+        heat = heat.replace(
+            appended=heat.appended + appended_n,
+            sent=heat.sent + sent_n,
+            commits=heat.commits + (commit - s.commit),
+            reads=heat.reads + n_served,
+        )
+
     dirty = (term != old_term) | (voted != old_voted) | (log.last != old_last) \
         | (app_to > 0)
 
@@ -1201,6 +1222,7 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         conf_idx=cidx2, conf_word=w2,
         xfer_to=xfer_to, xfer_dl=xfer_dl,
         trace=trace,
+        heat=heat,
     )
     outbox = Messages(
         ae_valid=out_ae_valid, ae_term=out_ae_term,

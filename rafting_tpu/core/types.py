@@ -184,6 +184,17 @@ class EngineConfig:
                                   #     group keeps the boot full-voter
                                   #     config (the BENCH_MEMBER A/B uses
                                   #     it to price the masked kernel).
+    heat: bool = False            # per-group heat lanes (HeatState):
+                                  #     cumulative appended / sent /
+                                  #     committed / reads-served counters
+                                  #     accumulated branchlessly each tick
+                                  #     and drained by the host into the
+                                  #     decaying heat registry (the active-
+                                  #     set evidence feed).  False keeps
+                                  #     the subtree None — the state
+                                  #     pytree and compiled step are bit-
+                                  #     identical to a heatless build,
+                                  #     same contract as trace_depth.
 
     def __post_init__(self):
         assert self.n_peers >= 1
@@ -297,6 +308,31 @@ class TraceState:
         return cls(tick=z(n_groups, depth), kind=z(n_groups, depth),
                    term=z(n_groups, depth), aux=z(n_groups, depth),
                    n=z(n_groups))
+
+
+@struct.dataclass
+class HeatState:
+    """Per-group activity lanes (cfg.heat): cumulative event counters the
+    fused step bumps branchlessly each tick, drained by the host into the
+    decaying heat registry (utils/heat.py).  Observability state like
+    TraceState — no step phase ever reads it back, it survives
+    crash_restart (activity history is not protocol state), and the
+    subtree is None when disabled so the compiled program is identical
+    to a heatless build.  Cumulative (not per-tick) so the host drain is
+    delta-vs-mirror and a skipped drain tick loses nothing."""
+
+    appended: jax.Array   # [G] int32 — entries appended to the log, ever
+    sent: jax.Array       # [G] int32 — RPCs emitted (all 7 kinds), ever
+    commits: jax.Array    # [G] int32 — commit-index advance, ever
+    reads: jax.Array      # [G] int32 — linearizable reads served, ever
+
+    @classmethod
+    def empty(cls, n_groups: int) -> "HeatState":
+        # Four distinct buffers: the lanes are donated through the jitted
+        # step, and donating one aliased array through several leaves is
+        # an XLA error ("donate the same buffer twice").
+        z = lambda: jnp.zeros((n_groups,), I32)
+        return cls(appended=z(), sent=z(), commits=z(), reads=z())
 
 
 def trace_append(tr: TraceState, mask: jax.Array, kind: int,
@@ -432,6 +468,10 @@ class RaftState:
     # compiled step/scan program — is bit-identical to a traceless build
     # (the zero-cost-when-off contract, tested in test_tracelog).
     trace: Any = None         # Optional[TraceState]
+
+    # Heat lanes (cfg.heat).  Same None-subtree contract as the recorder:
+    # disabled builds compile bit-identical programs.
+    heat: Any = None          # Optional[HeatState]
 
 
 @struct.dataclass
@@ -921,4 +961,5 @@ def init_state(cfg: EngineConfig, node_id: int, seed: int = 0,
         xfer_dl=z(G),
         trace=(TraceState.empty(G, cfg.trace_depth)
                if cfg.trace_depth else None),
+        heat=(HeatState.empty(G) if cfg.heat else None),
     )

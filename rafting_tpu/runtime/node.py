@@ -57,7 +57,7 @@ from ..snapshot.archive import SnapshotArchive
 from ..snapshot.policy import MaintainAgreement
 from ..transport import InboxAccumulator, messages_template
 from ..transport.codec import (
-    EAGER_KINDS, KIND_FIELDS, assemble_slice, pack_kind_section,
+    EAGER_KINDS, KIND_FIELDS, assemble_slice, pack_hops, pack_kind_section,
 )
 from ..api.anomaly import (
     BatchAbortedError, BusyLoopError, NotLeaderError, NotReadyError,
@@ -67,8 +67,10 @@ from ..api.anomaly import (
 from .admission import admission_from_env
 from .txn import txn_plane_from_env
 from ..log.wal import WalNoSpace, WalSyncError
+from ..utils.heat import heat_registry_from_env
 from ..utils.latency import (
-    ACKED, FSYNCED, OFFERED, SENT, SERVED, STAGED, tracer_from_env,
+    ACKED, FSYNCED, HOP_ECHO, HOP_REQUEST, OFFERED, SENT, SERVED, STAGED,
+    hops_from_env, tracer_from_env,
 )
 from ..utils.metrics import Metrics
 from ..utils.profiling import TickProfiler
@@ -239,7 +241,7 @@ class _TickCtx:
         "submit_n", "read_n", "staged_payloads", "arrays",
         # device refs (dispatch) -> host arrays (fetch)
         "info", "outbox", "term", "voted", "role", "leader", "commit",
-        "base", "base_term",
+        "base", "base_term", "heat",
         # Eager-send bookkeeping (pipelined mode): per-peer AE columns
         # whose payloads were not staged at fetch time — the host phase
         # packs exactly these after the barrier.  None = pipeline off
@@ -696,6 +698,32 @@ class RaftNode:
         self._wal_stat_last: Optional[dict] = None
         self.metrics.gauge(
             "lat_sample_rate", self._lat.rate if self._lat else 0)
+        # Per-group heat accounting (cfg.heat): the fetched device heat
+        # lanes drain into a decaying host registry each tick — top-K hot
+        # groups, idleness ages, and the active-set gauge (the proof
+        # metric for the sparse-tick work, ROADMAP item 2).  None when
+        # the config carries no heat lanes.
+        self.heat = heat_registry_from_env(G) if cfg.heat else None
+        if self.heat is not None:
+            for _c in ("heat_appended", "heat_sent", "heat_commits",
+                       "heat_reads"):
+                self.metrics[_c] += 0
+            self.metrics.gauge("heat_active_set", 0)
+            self.metrics.gauge("heat_half_life_ticks", self.heat.half_life)
+        # Cross-node hop tracing (utils/latency.py HopTracer): decomposes
+        # a sampled span's send_commit into per-peer wire/fsync/quorum
+        # segments via a HOPS sideband on the AE traffic.  Enabled by
+        # default whenever the transport exists — a node must echo hop
+        # contexts for its LEADERS' samples even if its own sampling is
+        # off — and disabled with RAFT_HOP_TRACE=0.
+        self._hops = hops_from_env(node_id, cfg.n_peers)
+        if self._hops is not None:
+            for _c in ("hop_tracked", "hop_requests_sent", "hop_echoes",
+                       "hop_finalized", "hop_dropped_unknown",
+                       "hop_expired", "hop_foreign_seen",
+                       "hop_foreign_expired"):
+                self.metrics[_c] += 0
+            self.transport.on_hops = self._on_hops
         # Flight-recorder drain (cfg.trace_depth > 0): per-group decoded
         # timelines + labeled metrics (elections by cause, leader churn)
         # harvested from the device event rings each tick.  Inert when
@@ -776,7 +804,44 @@ class RaftNode:
                 dict(s, stripe=i) for i, s in enumerate(per())]
         doc["worker_util"] = list(self._worker_util)
         doc["txn_plane"] = self.txn.snapshot()
+        if self._hops is not None:
+            # Hop-phase decomposition of send_commit (the fleet
+            # attribution plane) rides the latency document too, so one
+            # scrape answers both "when" and "where".
+            doc["hops"] = self._hops.snapshot(self.metrics)
         return doc
+
+    def heatmap_snapshot(self, k: int = 16) -> dict:
+        """The /heatmap document (runtime/obsrv.py): decayed top-K hot
+        groups, idleness-age distribution, active-set size.  Snapshot
+        reads only — safe off the tick thread (utils/heat.py)."""
+        if self.heat is None:
+            return {"enabled": False}
+        doc = {"enabled": True}
+        doc.update(self.heat.snapshot(k))
+        return doc
+
+    def hops_snapshot(self) -> dict:
+        """The /hops document (runtime/obsrv.py): per-peer and aggregate
+        hop-segment summaries + recent finalized decompositions."""
+        if self._hops is None:
+            return {"enabled": False}
+        doc = {"enabled": True}
+        doc.update(self._hops.snapshot(self.metrics))
+        return doc
+
+    def _on_hops(self, origin: int, direction: int, records,
+                 t_recv_ns: int) -> None:
+        """Transport reader-thread intake for HOPS frames (assigned as
+        ``transport.on_hops``): requests park on the follower half,
+        echoes on the leader half; both drain on the tick thread."""
+        h = self._hops
+        if h is None:
+            return
+        if direction == HOP_REQUEST:
+            h.recv_requests(origin, records, t_recv_ns)
+        else:
+            h.recv_echoes(origin, records, t_recv_ns)
 
     def close(self) -> None:
         self._stop.set()
@@ -799,6 +864,10 @@ class RaftNode:
             # histograms before the registry goes quiet (spans still in
             # flight stay un-counted — never a fabricated latency).
             self._lat.harvest(self.metrics)
+        if self._hops is not None:
+            # Same rule for hop contexts: fold what settled, never
+            # fabricate segments for spans still in flight.
+            self._hops.fold(self.metrics)
         if self._obsrv is not None:
             self._obsrv.close()
             self._obsrv = None
@@ -1269,6 +1338,11 @@ class RaftNode:
             # shared histograms — tick thread only, so the registry
             # keeps its single-writer contract (utils/metrics.py).
             self._lat.harvest(self.metrics)
+        if self._hops is not None:
+            # Pair echoes with pending contexts and finalize settled
+            # spans — after harvest so a span retired this tick already
+            # carries its outcome.
+            self._hops.fold(self.metrics)
         self.profiler.after_tick()
         return ctx.info
 
@@ -1470,6 +1544,7 @@ class RaftNode:
         ctx.role, ctx.leader = self.state.role, self.state.leader_id
         ctx.commit = self.state.commit
         ctx.base, ctx.base_term = self.state.log.base, self.state.log.base_term
+        ctx.heat = self.state.heat
         ctx.deferred_ae = None
         self._inflight_submit = self._inflight_submit + submit_n
         self._inflight_read = self._inflight_read + read_n
@@ -1485,11 +1560,12 @@ class RaftNode:
         cover."""
         cfg = self.cfg
         _w0 = time.perf_counter()
-        # One transfer for everything the host needs this tick.
+        # One transfer for everything the host needs this tick (the heat
+        # lanes ride it as a None subtree when cfg.heat is off).
         (h_info, h_out, h_term, h_voted, h_role, h_leader, h_commit, h_base,
-         h_base_term) = jax.device_get(
+         h_base_term, h_heat) = jax.device_get(
             (ctx.info, ctx.outbox, ctx.term, ctx.voted, ctx.role,
-             ctx.leader, ctx.commit, ctx.base, ctx.base_term))
+             ctx.leader, ctx.commit, ctx.base, ctx.base_term, ctx.heat))
         self.metrics.observe("tick_stage_scan_wait_s",
                              time.perf_counter() - _w0)
         ctx.info, ctx.outbox = h_info, h_out
@@ -1561,6 +1637,26 @@ class RaftNode:
                     if v:
                         self.metrics[k] += v
 
+        # -- heat drain ------------------------------------------------------
+        # The device heat lanes (cumulative per-group activity) fold into
+        # the decaying registry: one numpy delta against the mirror, a
+        # counter fold, and the active-set gauge.  Cumulative lanes mean
+        # a skipped drain (storage-fault tick) loses nothing.
+        if self.heat is not None and h_heat is not None:
+            d_app, d_sent, d_com, d_rd = self.heat.ingest(
+                self.ticks, h_heat.appended, h_heat.sent,
+                h_heat.commits, h_heat.reads)
+            m = self.metrics
+            if d_app:
+                m["heat_appended"] += d_app
+            if d_sent:
+                m["heat_sent"] += d_sent
+            if d_com:
+                m["heat_commits"] += d_com
+            if d_rd:
+                m["heat_reads"] += d_rd
+            m.gauge("heat_active_set", self.heat.active_set_size())
+
         self.ticks += 1
         self.metrics.gauge("groups_active", int(self.h_active.sum()))
         self.metrics.gauge(
@@ -1628,6 +1724,20 @@ class RaftNode:
         for sp in self._lat_tick:
             sp.mark(phase)
 
+    def _hops_scan(self, ctx: _TickCtx) -> None:
+        """Detect which peers' AE frames this tick cover a tracked
+        sampled span and queue hop requests for them; the records ride
+        the next per-peer flush.  Must run AFTER _persist_prepare (which
+        registers this tick's spans) — the AE frame carrying a freshly
+        appended entry is in THIS tick's outbox, and once followers ack
+        it no later frame ever covers that index again.  O(tracked
+        spans); a node with no live spans pays one attribute check."""
+        if self._hops is not None:
+            out = ctx.outbox
+            self._hops.scan_outbox(np.asarray(out.ae_valid),
+                                   np.asarray(out.ae_prev_idx),
+                                   np.asarray(out.ae_n))
+
     def _host_phase_serial(self, ctx: _TickCtx, defer_send: bool) -> None:
         G = self.cfg.n_groups
         _t0 = time.perf_counter()
@@ -1642,16 +1752,24 @@ class RaftNode:
         # at the barrier (_barrier) and at outbox packing (silence).
         need_sync = self._persist_stage(prep)
         self._sweep_rejections(prep)
+        self._hops_scan(ctx)
         ctx.staged_payloads = ctx.arrays = None   # drop frame pins early
         _t1 = time.perf_counter()
         if self._lat_tick:
             self._lat_stamp(STAGED)
+        if self._hops is not None:
+            self._hops.fold_foreign(self._durable_tail_m, fsynced=False)
         if need_sync or self._sync_pending:
             self._barrier()     # THE durability barrier
             self._barrier_ok()
         _t2 = time.perf_counter()
         if self._lat_tick:
             self._lat_stamp(FSYNCED)
+        if self._hops is not None:
+            # The fsynced stamp sits strictly after _barrier_ok(): a
+            # storage-fault abort above means an unsynced tail never
+            # produces a durability echo.
+            self._hops.fold_foreign(self._durable_tail_m, fsynced=True)
         self._watch_io(_t2 - _t1)
 
         # -- 5. release outbox (only ever after the barrier) -----------------
@@ -1784,7 +1902,13 @@ class RaftNode:
             # stamps share the all-shards-durable instant).
             self._lat_stamp(STAGED)
             self._lat_stamp(FSYNCED)
+        if self._hops is not None:
+            # Staged/fsynced collapse to the Phase A barrier here too;
+            # one fsynced fold stamps both and readies echoes for the
+            # Phase B flush.
+            self._hops.fold_foreign(self._durable_tail_m, fsynced=True)
         self._sweep_rejections(prep)
+        self._hops_scan(ctx)
         ctx.staged_payloads = ctx.arrays = None
 
         self.dispatcher.warm_mirror(G)
@@ -1881,7 +2005,10 @@ class RaftNode:
             # return (the split lives in the engine's wal_stats()).
             self._lat_stamp(STAGED)
             self._lat_stamp(FSYNCED)
+        if self._hops is not None:
+            self._hops.fold_foreign(self._durable_tail_m, fsynced=True)
         self._sweep_rejections(prep)
+        self._hops_scan(ctx)
         # The native call is done — the arena views the spans pinned are
         # no longer referenced from C.
         ctx.staged_payloads = ctx.arrays = None
@@ -2209,6 +2336,12 @@ class RaftNode:
                                 sp.mark(OFFERED)
                                 lat_tick.append(sp)
                                 tr.pending_commit.append(sp)
+                                if self._hops is not None:
+                                    # Hop attribution follows the span:
+                                    # its (group, idx) is pinned now, so
+                                    # the fetch-side coverage scan can
+                                    # match AE frames to it.
+                                    self._hops.track(sp)
                         b.taken += take
                         cursor += take
                         need -= take
@@ -2977,10 +3110,22 @@ class RaftNode:
                 aux=s.trace.aux.at[idx].set(0),
                 n=s.trace.n.at[idx].set(0))
                 if s.trace is not None else None),
+            heat=(s.heat.replace(
+                appended=s.heat.appended.at[idx].set(0),
+                sent=s.heat.sent.at[idx].set(0),
+                commits=s.heat.commits.at[idx].set(0),
+                reads=s.heat.reads.at[idx].set(0))
+                if s.heat is not None else None),
         )
         if s.trace is not None:
             for g in lanes:
                 self.tracelog.reset_group(int(g))
+        if self.heat is not None:
+            # Device heat lanes just reset to 0 — the registry's
+            # cumulative mirror must follow or the next ingest would see
+            # a negative delta for the recreated lane.
+            for g in lanes:
+                self.heat.reset_group(int(g))
         # device_get arrays may be read-only views; replace, don't mutate
         hc = np.array(self.h_commit)
         hb = np.array(self.h_base)
@@ -3113,10 +3258,30 @@ class RaftNode:
         a peer's frame combines the previous tick's post-fsync sections
         with this tick's eager AE sections (eager last — for a lane
         duplicated across sections, unpack's scatter is last-wins, so
-        the newer AE stands)."""
+        the newer AE stands).
+
+        Hop-tracing sideband: pending HOPS requests/echoes piggyback on
+        the same send_slice blob (FrameReader parses concatenated
+        frames), so hop records share fate with the tick's real traffic
+        — a cut link delays both identically and ``wire`` measures the
+        path the entries actually took."""
         held, self._held_sections = self._held_sections, {}
+        hops = self._hops
+        if hops is not None:
+            for p in hops.out_peers():
+                held.setdefault(p, [])
         for p, secs in held.items():
-            self.transport.send_slice(p, assemble_slice(self.node_id, secs))
+            blob = assemble_slice(self.node_id, secs) if secs else b""
+            if hops is not None:
+                out = hops.take_out(p)
+                if out is not None:
+                    reqs, echoes = out
+                    if reqs:
+                        blob += pack_hops(HOP_REQUEST, self.node_id, reqs)
+                    if echoes:
+                        blob += pack_hops(HOP_ECHO, self.node_id, echoes)
+            if blob:
+                self.transport.send_slice(p, blob)
 
     # -------------------------------------------------------------- maintain
 

@@ -19,7 +19,18 @@ curl and scraped by Prometheus, with no new dependencies:
 * ``GET /latency``            — the sampled commit-path latency plane
   (``utils/latency.py``): sampler state, SLO burn, per-phase and
   end-to-end percentile tables, recent sampled spans with per-phase
-  breakdowns, and the WAL engines' per-stripe stage/fsync/pack stats.
+  breakdowns, and the WAL engines' per-stripe stage/fsync/pack stats —
+  plus the cross-node hop decomposition (``hops`` subdocument);
+* ``GET /heatmap?k=N``        — the per-group heat registry
+  (``utils/heat.py``): top-K hot groups by decayed work score, the
+  active-set size gauge, and the idleness-age distribution;
+* ``GET /hops``               — the hop tracer alone: per-peer and
+  aggregate segment summaries (leader_pack / wire / follower_fsync /
+  ack_return / quorum_wait), bookkeeping counters, recent traces.
+
+Malformed query parameters and unknown paths return typed 4xx JSON
+documents (``{"error": <kind>, ...}``); handler bugs degrade to a typed
+500 — never a traceback on the socket.
 
 Handlers only READ tick-refreshed host mirrors (``h_role``/``h_ready``/
 ``metrics``/``tracelog``) — the same bounded one-tick staleness contract
@@ -69,33 +80,75 @@ class ObservabilityServer:
                 self._reply(code, json.dumps(doc).encode(),
                             "application/json")
 
+            def _bad(self, kind: str, detail: str) -> None:
+                """Typed 4xx: machine-matchable ``error`` kind + a human
+                detail line — malformed input is a client problem and
+                must never surface as a 500/traceback."""
+                self._json(400, {"error": kind, "detail": detail})
+
+            def _int_param(self, q, name: str, default: int, lo: int,
+                           hi: int):
+                """Parse an integer query param with bounds.  Returns
+                the value, or None AFTER replying 400 (typed) — callers
+                just ``return`` on None."""
+                raw = q.get(name, [None])[0]
+                if raw is None:
+                    return default
+                try:
+                    v = int(raw)
+                except ValueError:
+                    self._bad("bad_param",
+                              f"{name}={raw!r} is not an integer")
+                    return None
+                if not lo <= v <= hi:
+                    self._bad("param_out_of_range",
+                              f"{name}={v} outside [{lo}, {hi}]")
+                    return None
+                return v
+
             def do_GET(self):
                 try:
                     url = urlparse(self.path)
+                    q = parse_qs(url.query)
                     if url.path == "/metrics":
                         body = outer.node.metrics.render_prometheus()
                         self._reply(200, body.encode(), PROM_CONTENT_TYPE)
                     elif url.path == "/healthz":
                         self._json(200, outer.healthz())
                     elif url.path == "/timeline":
-                        q = parse_qs(url.query)
-                        try:
-                            g = int(q.get("group", ["0"])[0])
-                        except ValueError:
-                            g = -1
-                        if not 0 <= g < outer.node.cfg.n_groups:
-                            self._json(400, {"error": "bad group"})
+                        g = self._int_param(
+                            q, "group", 0, 0,
+                            outer.node.cfg.n_groups - 1)
+                        if g is None:
                             return
                         self._json(200, outer.timeline(g))
                     elif url.path == "/latency":
                         self._json(200, outer.node.latency_snapshot())
+                    elif url.path == "/heatmap":
+                        k = self._int_param(q, "k", 16, 1, 1024)
+                        if k is None:
+                            return
+                        self._json(200, outer.node.heatmap_snapshot(k))
+                    elif url.path == "/hops":
+                        self._json(200, outer.node.hops_snapshot())
                     else:
-                        self._json(404, {"error": "unknown path",
+                        self._json(404, {"error": "unknown_path",
                                          "paths": ["/metrics", "/healthz",
                                                    "/timeline?group=N",
-                                                   "/latency"]})
+                                                   "/latency",
+                                                   "/heatmap?k=N",
+                                                   "/hops"]})
                 except BrokenPipeError:
                     pass
+                except Exception as e:  # noqa: BLE001 — a handler bug
+                    # must degrade to a typed 500 document, not a
+                    # half-written traceback on the socket.
+                    try:
+                        self._json(500, {"error": "internal",
+                                         "detail": f"{type(e).__name__}: "
+                                                   f"{e}"})
+                    except Exception:
+                        pass
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True

@@ -182,6 +182,15 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         tr_n = s["trace.n"].copy()
         D = tr_tick.shape[1]
 
+    # Heat lanes (cfg.heat): the scalar mirror of the kernel's cumulative
+    # per-group activity counters (appended / sent / commits / reads).
+    has_heat = state.heat is not None
+    if has_heat:
+        ht_app = s["heat.appended"].copy()
+        ht_sent = s["heat.sent"].copy()
+        ht_com = s["heat.commits"].copy()
+        ht_rd = s["heat.reads"].copy()
+
     old_term = term.copy()
     old_voted = voted.copy()
     old_last = last.copy()
@@ -906,6 +915,22 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         info["commit"][g] = commit[g]
         info["leader"][g] = leader_id[g]
 
+        # ---- 12. heat lanes -----------------------------------------------
+        # (kernel trailing block: per-group cumulative activity.  By the
+        # end of this iteration every out[...][:, g] column is final, so
+        # the sent count matches the kernel's sum over the outbox valid
+        # planes exactly.)
+        if has_heat:
+            sent_n = 0
+            for k in ("ae_valid", "aer_valid", "rv_valid", "rvr_valid",
+                      "is_valid", "isr_valid", "tn_valid"):
+                for p in range(P):
+                    sent_n += int(out[k][p, g])
+            ht_app[g] += (app_to - app_from + 1) if app_to > 0 else 0
+            ht_sent[g] += sent_n
+            ht_com[g] += int(commit[g]) - int(old_commit[g])
+            ht_rd[g] += n_served
+
     new_state = {
         "node_id": np.asarray(me, np.int32),
         "now": np.asarray(now, np.int32),
@@ -938,5 +963,10 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         new_state.update({
             "trace.tick": tr_tick, "trace.kind": tr_kind,
             "trace.term": tr_term, "trace.aux": tr_aux, "trace.n": tr_n,
+        })
+    if has_heat:
+        new_state.update({
+            "heat.appended": ht_app, "heat.sent": ht_sent,
+            "heat.commits": ht_com, "heat.reads": ht_rd,
         })
     return new_state, out, info

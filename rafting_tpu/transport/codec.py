@@ -52,6 +52,17 @@ FWD_READ = 7
 # target/0).  Replies travel as FWD_RESP with a JSON result.
 FWD_CONF = 8
 CONF_OP_CHANGE, CONF_OP_TRANSFER = 1, 2
+# Hop-tracing sideband (utils/latency.py HopTracer): a leader attaches a
+# compact trace context to the AE traffic shipping a SAMPLED entry
+# (direction 0, request), and the follower echoes it back with
+# single-clock durability durations (direction 1, echo).  The frames
+# piggyback on the same per-peer blob as the MSGS slice — one send, no
+# extra wire round trips — and the kind is OUTSIDE SCHEMA_TAG (the tag
+# covers the MSGS column layout only), so a hop-aware node interoperates
+# with a hop-blind one: an unrecognized frame type falls through the
+# reader's dispatch unhandled, and the ignored context simply expires
+# leader-side (never fabricates a latency).
+HOPS = 9
 
 MAX_BODY = 64 << 20  # 64 MB cap, matching the reference (EventCodec.java:26)
 
@@ -221,6 +232,49 @@ def unpack_hello(body: bytes) -> Tuple[int, int, int, int, int]:
     if len(body) == 16:
         return struct.unpack("<IIII", body) + (0,)
     return struct.unpack("<IIIII", body)
+
+
+# HOPS bodies: header (direction, origin node id, record count), then
+# fixed-size records.  Requests carry the span's wire identity and the
+# leader's send stamp (echoed back verbatim so the leader never needs a
+# lookup to interpret an echo); echoes carry the follower's OWN-clock
+# durations from frame arrival — receive->staged, receive->fsynced, and
+# receive->echo-send (the residence the leader subtracts from its rtt
+# for the clock-skew-free one-way estimate).
+_HOPS_HDR = struct.Struct("<BBH")      # direction, origin, count
+_HOP_REQ = struct.Struct("<IIiq")      # hop_id, group, idx, t_send_ns
+_HOP_ECHO = struct.Struct("<Iqqqq")    # hop_id, t_send_ns, d_staged_ns,
+#                                        d_fsync_ns, d_echo_ns
+_HOPS_MAX = 0xFFFF
+
+
+def pack_hops(direction: int, origin: int, records) -> bytes:
+    """One HOPS frame.  ``records`` are request tuples
+    ``(hop_id, group, idx, t_send_ns)`` when ``direction`` is
+    HOP_REQUEST (0), echo tuples ``(hop_id, t_send_ns, d_staged_ns,
+    d_fsync_ns, d_echo_ns)`` when HOP_ECHO (1)."""
+    n = len(records)
+    if n > _HOPS_MAX:
+        records = records[:_HOPS_MAX]
+        n = _HOPS_MAX
+    rec = _HOP_REQ if direction == 0 else _HOP_ECHO
+    return frame(HOPS, _HOPS_HDR.pack(direction, origin, n)
+                 + b"".join(rec.pack(*r) for r in records))
+
+
+def unpack_hops(body: bytes):
+    """Returns ``(direction, origin, [record tuples])``; malformed
+    bodies raise IOError like every other frame (reader treats it as a
+    connection drop)."""
+    if len(body) < _HOPS_HDR.size:
+        raise IOError("truncated HOPS body")
+    direction, origin, n = _HOPS_HDR.unpack_from(body, 0)
+    rec = _HOP_REQ if direction == 0 else _HOP_ECHO
+    if len(body) != _HOPS_HDR.size + n * rec.size:
+        raise IOError("truncated HOPS body (malformed frame)")
+    return direction, origin, [
+        rec.unpack_from(body, _HOPS_HDR.size + i * rec.size)
+        for i in range(n)]
 
 
 def pack_snap_req(group: int, index: int, term: int) -> bytes:

@@ -183,6 +183,16 @@ class LoopbackTransport:
                 src, fields, payloads = codec.unpack_slice(
                     body, self.template, self.cfg.n_groups)
                 self.on_slice(src, fields, payloads)
+            elif ftype == codec.HOPS:
+                # Hop-tracing sideband — ``on_hops`` is assigned by the
+                # runtime after construction (see TcpTransport); unset
+                # means the owner is hop-blind and the frame is ignored.
+                handler = getattr(self, "on_hops", None)
+                if handler is not None:
+                    import time as _time
+                    t_recv = _time.perf_counter_ns()
+                    direction, origin, records = codec.unpack_hops(body)
+                    handler(origin, direction, records, t_recv)
 
     def _mirror(self, name: str) -> None:
         m = getattr(self, "metrics", None)

@@ -396,6 +396,24 @@ class TcpTransport:
                                         "dropped", s, src)
                             continue  # source spoof guard
                         self.on_slice(s, fields, payloads)
+                    elif ftype == codec.HOPS:
+                        # Hop-tracing sideband (utils/latency.py): rides
+                        # the persistent channel, so the HELLO identity
+                        # guards it exactly like MSGS.  ``on_hops`` is
+                        # assigned by the runtime after construction
+                        # (same pattern as ``transport.metrics``); a
+                        # hop-blind owner leaves it unset and the frame
+                        # is ignored.
+                        handler = getattr(self, "on_hops", None)
+                        if handler is None or src is None:
+                            continue
+                        t_recv = time.perf_counter_ns()
+                        direction, origin, records = codec.unpack_hops(body)
+                        if origin != src:
+                            log.warning("HOPS origin %d != channel src %d "
+                                        "— dropped", origin, src)
+                            continue
+                        handler(origin, direction, records, t_recv)
                     elif ftype == codec.SNAP_REQ:
                         self._serve_snapshot(conn, body)
                         return  # ephemeral connection: one fetch, then close

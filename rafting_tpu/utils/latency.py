@@ -407,6 +407,344 @@ class LatencyTracer:
         return doc
 
 
+# ---------------------------------------------------------------------------
+# Cross-node hop tracing (the fleet attribution plane).
+#
+# A sampled write span tells us WHEN the replication phase (send_commit)
+# burned its time but not WHERE.  For every sampled span the leader
+# attaches a compact hop context to the AppendEntries traffic that ships
+# the entry (transport/codec.py HOPS frames, piggybacked on the same
+# per-peer slice); the follower stamps receive → staged → fsynced on its
+# OWN clock and echoes the context with single-clock durations; the
+# leader pairs the echo like an RPC and decomposes the phase into
+#
+#   leader_pack     AE computed -> frame handed to the transport
+#                   (leader clock; includes the persist-before-send
+#                   barrier in serial mode)
+#   wire            one-way estimate: (rtt - follower_residence) / 2
+#                   (both terms single-clock: rtt on the leader,
+#                   residence on the follower — clock skew cancels)
+#   follower_fsync  receive -> entry durable (follower clock)
+#   ack_return      remainder of the rtt after wire + fsync (the
+#                   follower's post-fsync residence + the return trip)
+#   quorum_wait     echo received -> commit stamped (leader clock;
+#                   waiting on the rest of the quorum + tick cadence)
+#
+# The five segments telescope: leader_pack + wire + follower_fsync +
+# ack_return = (t_send - t_pack) + rtt, and quorum_wait covers echo ->
+# commit, so for any peer whose echo beat the commit the sum equals
+# commit - t_pack exactly — which is send_commit plus the sub-tick
+# pack-to-SENT sliver (the ≤5% reconciliation in tests/test_hops.py).
+# Spans that die before committing are DROPPED (never fabricate a hop
+# latency); un-echoed contexts expire by TTL on both ends.
+# ---------------------------------------------------------------------------
+
+HOP_SEGMENTS = ("leader_pack", "wire", "follower_fsync", "ack_return",
+                "quorum_wait")
+
+# HOPS frame directions (transport/codec.py pack_hops).
+HOP_REQUEST, HOP_ECHO = 0, 1
+
+
+class _HopRec:
+    """Leader-side pending context for one sampled span's replication."""
+
+    __slots__ = ("hop_id", "span", "t_pack", "born", "sent", "echo")
+
+    def __init__(self, hop_id: int, span: Span, born_ns: int):
+        self.hop_id = hop_id
+        self.span = span
+        self.t_pack = 0       # ns — first AE coverage detected
+        self.born = born_ns
+        self.sent = {}        # peer -> t_send_ns (0 = queued, unsent)
+        self.echo = {}        # peer -> (t_echo_recv_ns, rtt_ns,
+        #                       d_staged_ns, d_fsync_ns, d_echo_ns)
+
+
+class _ForeignHop:
+    """Follower-side context received from an origin leader."""
+
+    __slots__ = ("origin", "hop_id", "group", "idx", "t_send", "t_recv",
+                 "d_staged", "d_fsync")
+
+    def __init__(self, origin: int, hop_id: int, group: int, idx: int,
+                 t_send: int, t_recv: int):
+        self.origin = origin
+        self.hop_id = hop_id
+        self.group = group
+        self.idx = idx
+        self.t_send = t_send      # origin clock, echoed back verbatim
+        self.t_recv = t_recv      # OUR clock (reader-thread arrival)
+        self.d_staged = 0         # ns from t_recv (our clock)
+        self.d_fsync = 0
+
+
+class HopTracer:
+    """Per-node hop bookkeeping — both roles at once (every node leads
+    some groups and follows others).
+
+    Thread contract: ``recv_requests``/``recv_echoes`` run on transport
+    reader threads (lock-free deque appends); everything else —
+    ``track``, ``scan_outbox``, ``fold_foreign``, ``take_out``,
+    ``fold`` — runs on the tick/host-phase thread only."""
+
+    def __init__(self, node_id: int, n_peers: int, ttl_s: float = 30.0,
+                 recent: int = 64):
+        self.node_id = int(node_id)
+        self.n_peers = int(n_peers)
+        self._ttl_ns = int(ttl_s * 1e9)
+        # Leader side.
+        self._next_id = 1
+        self._live: Dict[int, _HopRec] = {}
+        self._by_group: Dict[int, List[_HopRec]] = {}
+        self._out_req: Dict[int, List[_HopRec]] = {}    # peer -> queued
+        self._in_echo: deque = deque()   # (origin, records, t_recv_ns)
+        # Follower side.
+        self._in_req: deque = deque()    # (origin, records, t_recv_ns)
+        self._foreign: List[_ForeignHop] = []
+        self._out_echo: Dict[int, List[_ForeignHop]] = {}
+        self.recent: deque = deque(maxlen=recent)
+        self.counts: Dict[str, int] = {
+            "tracked": 0, "requests_sent": 0, "echoes": 0,
+            "echo_orphan": 0, "finalized": 0, "dropped_unknown": 0,
+            "expired": 0, "foreign_seen": 0, "foreign_expired": 0}
+
+    # -- leader: context creation + AE coverage -------------------------
+    def track(self, span: Span) -> None:
+        """Register a device-accepted sampled span (group/idx pinned)
+        for hop attribution.  Tick thread."""
+        r = _HopRec(self._next_id, span, time.perf_counter_ns())
+        self._next_id += 1
+        self._live[r.hop_id] = r
+        self._by_group.setdefault(span.group, []).append(r)
+        self.counts["tracked"] += 1
+
+    def scan_outbox(self, ae_valid, ae_prev_idx, ae_n) -> None:
+        """Detect which peers' AE frames this tick cover a tracked
+        span's (group, idx) and queue a hop request for each — one per
+        (span, peer), first coverage wins.  Arrays are the host-fetched
+        [P, G] outbox planes; the walk is over tracked groups only (at
+        most a handful of sampled spans are live)."""
+        if not self._by_group:
+            return
+        now = time.perf_counter_ns()
+        for g, recs in self._by_group.items():
+            for r in recs:
+                idx = r.span.idx
+                for p in range(self.n_peers):
+                    if p == self.node_id or p in r.sent:
+                        continue
+                    if ae_valid[p, g]:
+                        prev = int(ae_prev_idx[p, g])
+                        if prev < idx <= prev + int(ae_n[p, g]):
+                            if r.t_pack == 0:
+                                r.t_pack = now
+                            r.sent[p] = 0
+                            self._out_req.setdefault(p, []).append(r)
+
+    # -- follower: intake + durability stamping -------------------------
+    def recv_requests(self, origin: int, records, t_recv_ns: int) -> None:
+        """Reader thread: park an inbound HOPS request batch."""
+        self._in_req.append((origin, records, t_recv_ns))
+
+    def recv_echoes(self, origin: int, records, t_recv_ns: int) -> None:
+        """Reader thread: park an inbound HOPS echo batch."""
+        self._in_echo.append((origin, records, t_recv_ns))
+
+    def fold_foreign(self, tail, fsynced: bool) -> None:
+        """Tick/host-phase thread: drain inbound requests and stamp the
+        ones whose (group, idx) the given per-group tail now covers —
+        ``fsynced=False`` after staging (marks ``staged``),
+        ``fsynced=True`` after the durability barrier (marks ``fsynced``
+        and readies the echo for the next flush to the origin)."""
+        while self._in_req:
+            origin, records, t_recv = self._in_req.popleft()
+            for hop_id, group, idx, t_send in records:
+                self._foreign.append(_ForeignHop(
+                    origin, hop_id, int(group), int(idx), t_send, t_recv))
+                self.counts["foreign_seen"] += 1
+        if not self._foreign:
+            return
+        now = time.perf_counter_ns()
+        keep: List[_ForeignHop] = []
+        for f in self._foreign:
+            if 0 <= f.group < len(tail) and int(tail[f.group]) >= f.idx:
+                if f.d_staged == 0:
+                    f.d_staged = max(now - f.t_recv, 1)
+                if fsynced:
+                    f.d_fsync = max(now - f.t_recv, 1)
+                    self._out_echo.setdefault(f.origin, []).append(f)
+                    continue
+            elif now - f.t_recv > self._ttl_ns:
+                # The entry never became durable here (conflict
+                # truncation, leadership churn, lane close): expire —
+                # an unstamped context must never fabricate a latency.
+                self.counts["foreign_expired"] += 1
+                continue
+            keep.append(f)
+        self._foreign = keep
+
+    # -- both roles: outbound records for one peer ----------------------
+    def take_out(self, peer: int):
+        """Outbound hop records riding this flush to ``peer``:
+        ``(requests, echoes)`` or None.  Stamps send times (requests)
+        and residence (echoes) NOW — call immediately before handing
+        the peer's bytes to the transport.  Tick/host-phase thread."""
+        reqs = self._out_req.pop(peer, None)
+        echoes = self._out_echo.pop(peer, None)
+        if not reqs and not echoes:
+            return None
+        t = time.perf_counter_ns()
+        req_records = []
+        for r in reqs or ():
+            r.sent[peer] = t
+            req_records.append((r.hop_id, r.span.group, r.span.idx, t))
+            self.counts["requests_sent"] += 1
+        echo_records = []
+        for f in echoes or ():
+            echo_records.append((f.hop_id, f.t_send, f.d_staged,
+                                 f.d_fsync, max(t - f.t_recv, 1)))
+        return req_records, echo_records
+
+    def has_out(self, peer: int) -> bool:
+        return peer in self._out_req or peer in self._out_echo
+
+    def out_peers(self):
+        return set(self._out_req) | set(self._out_echo)
+
+    # -- leader: echo folding + finalization ----------------------------
+    def fold(self, metrics) -> None:
+        """Tick thread: pair echoes with pending contexts, finalize
+        contexts whose span settled (observing per-peer segment
+        histograms for committed spans only), expire the rest by TTL,
+        and fold the counters into the registry."""
+        while self._in_echo:
+            origin, records, t_recv = self._in_echo.popleft()
+            for hop_id, _t_send, d_staged, d_fsync, d_echo in records:
+                r = self._live.get(hop_id)
+                if r is None:
+                    self.counts["echo_orphan"] += 1
+                    continue
+                t_sent = r.sent.get(origin, 0)
+                if not t_sent or origin in r.echo:
+                    continue
+                r.echo[origin] = (t_recv, max(t_recv - t_sent, 0),
+                                  d_staged, d_fsync, d_echo)
+                self.counts["echoes"] += 1
+        if self._live:
+            now = time.perf_counter_ns()
+            done: List[int] = []
+            for hop_id, r in self._live.items():
+                sp = r.span
+                if sp.outcome is None:
+                    if now - r.born > self._ttl_ns:
+                        done.append(hop_id)
+                        self.counts["expired"] += 1
+                    continue
+                done.append(hop_id)
+                if sp.outcome != "ok" or sp.t[COMMITTED] <= 0.0:
+                    # Crashed / refused / outcome-unknown span: its hop
+                    # context dies with it — no segment is observed.
+                    self.counts["dropped_unknown"] += 1
+                    continue
+                self._observe(r, metrics)
+            for hop_id in done:
+                r = self._live.pop(hop_id)
+                recs = self._by_group.get(r.span.group)
+                if recs is not None:
+                    try:
+                        recs.remove(r)
+                    except ValueError:
+                        pass
+                    if not recs:
+                        del self._by_group[r.span.group]
+        c = self.counts
+        metrics["hop_tracked"] = c["tracked"]
+        metrics["hop_requests_sent"] = c["requests_sent"]
+        metrics["hop_echoes"] = c["echoes"]
+        metrics["hop_finalized"] = c["finalized"]
+        metrics["hop_dropped_unknown"] = c["dropped_unknown"]
+        metrics["hop_expired"] = c["expired"]
+        metrics["hop_foreign_seen"] = c["foreign_seen"]
+        metrics["hop_foreign_expired"] = c["foreign_expired"]
+
+    def _observe(self, r: _HopRec, metrics) -> None:
+        t_commit = r.span.t[COMMITTED]
+        peers = {}
+        for p, (t_er, rtt, _d_staged, d_fsync, d_echo) in r.echo.items():
+            t_send = r.sent.get(p, 0)
+            if not t_send or r.t_pack == 0:
+                continue
+            rtt_s = rtt * 1e-9
+            resid_s = min(max(d_echo, 0) * 1e-9, rtt_s)
+            wire = (rtt_s - resid_s) / 2.0
+            fsync_s = min(max(d_fsync, 0) * 1e-9, resid_s)
+            segs = {
+                "leader_pack": max(t_send - r.t_pack, 0) * 1e-9,
+                "wire": wire,
+                "follower_fsync": fsync_s,
+                "ack_return": max(rtt_s - wire - fsync_s, 0.0),
+                "quorum_wait": max(t_commit - t_er * 1e-9, 0.0),
+            }
+            peers[p] = segs
+            for name, v in segs.items():
+                metrics.observe(f"hop_{name}_s", v)
+                metrics.observe(f"hop_{name}_p{p}_s", v)
+        if peers:
+            self.counts["finalized"] += 1
+            sp = r.span
+            sc = (t_commit - sp.t[SENT]) if sp.t[SENT] > 0.0 else 0.0
+            self.recent.append({
+                "seq": sp.seq, "group": sp.group, "idx": sp.idx,
+                "tick": sp.tick, "send_commit_s": round(sc, 9),
+                "peers": {p: {k: round(v, 9) for k, v in segs.items()}
+                          for p, segs in peers.items()},
+            })
+
+    # -- views -----------------------------------------------------------
+    def snapshot(self, metrics) -> dict:
+        """The /hops document: per-peer and aggregate segment summaries
+        + bookkeeping counters + recent finalized decompositions."""
+        def summarize(name):
+            h = metrics._histograms.get(name)
+            if h is None or not h.n:
+                return None
+            return h.summary() | {"p999": h.quantile(0.999)}
+
+        segments = {}
+        for seg in HOP_SEGMENTS:
+            agg = summarize(f"hop_{seg}_s")
+            if agg is None:
+                continue
+            per_peer = {}
+            for p in range(self.n_peers):
+                s = summarize(f"hop_{seg}_p{p}_s")
+                if s is not None:
+                    per_peer[p] = s
+            segments[seg] = {"all": agg, "peers": per_peer}
+        return {
+            "counts": dict(self.counts),
+            "pending": len(self._live),
+            "foreign_pending": len(self._foreign),
+            "segments": segments,
+            "recent": list(self.recent),
+        }
+
+
+def hops_from_env(node_id: int, n_peers: int) -> Optional[HopTracer]:
+    """Build the node's hop tracer from RAFT_HOP_TRACE (default on;
+    0/false disables).  Cheap when idle: a node with latency sampling
+    off never tracks a span, so the per-tick fold is a no-op — but the
+    tracer must exist on FOLLOWERS regardless of their own sampling
+    config, or a sampled leader's contexts would never echo."""
+    import os
+    raw = os.environ.get("RAFT_HOP_TRACE", "").strip().lower()
+    if raw in ("0", "false", "no", "off"):
+        return None
+    ttl = float(os.environ.get("RAFT_HOP_TTL_S", "30"))
+    return HopTracer(node_id, n_peers, ttl_s=max(ttl, 1.0))
+
+
 def tracer_from_env(seed: int = 0, slo_s: float = 0.5,
                     default_rate: int = 64) -> Optional[LatencyTracer]:
     """Build the node's tracer from RAFT_LAT_SAMPLE (1/N sampling;

@@ -255,19 +255,34 @@ def format_aux(kind: int, aux: int) -> str:
 
 # ------------------------------------------------------------------ dumps --
 
+def _open_dump(path: str, mode: str = "rt"):
+    """Gzip-transparent artifact open: a ``.gz`` path (de)compresses,
+    and a bare path being READ falls back to its ``.gz`` sibling when
+    only the compressed form exists on disk."""
+    import gzip
+    import os
+    if path.endswith(".gz"):
+        return gzip.open(path, mode)
+    if "r" in mode and not os.path.exists(path) \
+            and os.path.exists(path + ".gz"):
+        return gzip.open(path + ".gz", mode)
+    return open(path, mode)
+
+
 def save_dump(path: str, trace, meta: Optional[dict] = None) -> None:
     """Persist raw rings as a JSON artifact for post-mortem decoding
     (tools/dump_timeline.py).  Accepts a TraceState ([G, D] single node or
-    [N, G, D] stacked cluster) or a ``trace_to_numpy`` dict."""
+    [N, G, D] stacked cluster) or a ``trace_to_numpy`` dict.  A ``.gz``
+    path writes gzip-compressed (ring dumps are big and repetitive)."""
     lanes = trace if isinstance(trace, dict) else trace_to_numpy(trace)
     doc = {name: np.asarray(arr).tolist() for name, arr in lanes.items()}
     doc["_meta"] = dict(meta or {})
-    with open(path, "w") as f:
+    with _open_dump(path, "wt") as f:
         json.dump(doc, f)
 
 
 def load_dump(path: str) -> Dict[str, np.ndarray]:
-    with open(path) as f:
+    with _open_dump(path) as f:
         doc = json.load(f)
     return {name: np.asarray(doc[name], np.int64)
             for name in ("tick", "kind", "term", "aux", "n")}

@@ -1,0 +1,148 @@
+"""tools/bench_diff.py (ISSUE 18 satellite): cross-round regression
+flagging over the committed BENCH_RUNTIME JSON-lines artifacts, plus
+the gzip-transparent artifact plumbing the chaos/bench writers share.
+"""
+
+import gzip
+import importlib.util
+import json
+import os
+
+import pytest
+
+_here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name, rel):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_here, rel))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+bench_diff = _load("bench_diff", "tools/bench_diff.py")
+_artifact = _load("_artifact", "tools/_artifact.py")
+
+
+def _round(path, rows):
+    with open(path, "w") as f:
+        f.write("some log noise\n")        # non-JSON lines are skipped
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    return str(path)
+
+
+def _row(metric, value, unit="durable commits/sec", p99=0.01):
+    return {"metric": metric, "value": value, "unit": unit,
+            "tick_latency": {"p50_s": p99 / 2, "p99_s": p99,
+                             "max_s": p99 * 2, "ticks": 100}}
+
+
+def test_clean_rounds_exit_zero(tmp_path, capsys):
+    old = _round(tmp_path / "old.json",
+                 [_row("c/s @4k", 1000.0), _row("c/s @32k", 5000.0)])
+    new = _round(tmp_path / "new.json",
+                 [_row("c/s @4k", 960.0), _row("c/s @32k", 5200.0)])
+    assert bench_diff.main([old, new]) == 0
+    out = capsys.readouterr().out
+    assert "0 flagged" in out
+
+
+def test_throughput_regression_flags_and_exits_one(tmp_path):
+    old = _round(tmp_path / "old.json", [_row("c/s @4k", 1000.0)])
+    new = _round(tmp_path / "new.json", [_row("c/s @4k", 850.0)])
+    res = bench_diff.diff(bench_diff.load_round(old),
+                          bench_diff.load_round(new))
+    assert len(res["flags"]) == 1
+    f = res["flags"][0]
+    assert f["kind"] == "throughput_regression"
+    assert f["drop_pct"] == 15.0
+    assert bench_diff.main([old, new]) == 1
+    # An 8% drop stays under the default 10% threshold...
+    new2 = _round(tmp_path / "new2.json", [_row("c/s @4k", 920.0)])
+    assert bench_diff.main([old, new2]) == 0
+    # ...but a tightened threshold flags it.
+    assert bench_diff.main([old, new2, "--threshold", "0.05"]) == 1
+
+
+def test_p999_blowup_flags(tmp_path):
+    old = _round(tmp_path / "old.json",
+                 [_row("c/s @4k", 1000.0, p99=0.010)])
+    new = _round(tmp_path / "new.json",
+                 [_row("c/s @4k", 990.0, p99=0.050)])
+    res = bench_diff.diff(bench_diff.load_round(old),
+                          bench_diff.load_round(new))
+    assert [f["kind"] for f in res["flags"]] == ["p999_blowup"]
+    assert res["flags"][0]["factor"] == 5.0
+    assert res["flags"][0]["source"] == "tick_p99_s"
+
+
+def test_e2e_p999_preferred_and_sources_never_mixed(tmp_path):
+    """A round with the sampled latency plane compares e2e p999; a pair
+    where only one side has it must NOT compare e2e-vs-tick."""
+    with_lat = _row("c/s @4k", 1000.0, p99=0.010)
+    with_lat["latency"] = {"e2e": {"p999_s": 0.020}}
+    blown = _row("c/s @4k", 990.0, p99=0.010)
+    blown["latency"] = {"e2e": {"p999_s": 0.200}}
+    old = _round(tmp_path / "old.json", [with_lat])
+    new = _round(tmp_path / "new.json", [blown])
+    res = bench_diff.diff(bench_diff.load_round(old),
+                          bench_diff.load_round(new))
+    assert res["flags"][0]["source"] == "e2e_p999_s"
+    # Mixed sources: old has e2e, new only tick → informational only.
+    mixed = _round(tmp_path / "mixed.json",
+                   [_row("c/s @4k", 990.0, p99=0.010)])
+    res = bench_diff.diff(bench_diff.load_round(old),
+                          bench_diff.load_round(mixed))
+    assert res["flags"] == []
+
+
+def test_new_stage_is_informational_not_flagged(tmp_path):
+    old = _round(tmp_path / "old.json", [_row("c/s @4k", 1000.0)])
+    new = _round(tmp_path / "new.json",
+                 [_row("c/s @4k", 1000.0),
+                  _row("overhead @100k", 0.01, unit="% regression")])
+    res = bench_diff.diff(bench_diff.load_round(old),
+                          bench_diff.load_round(new))
+    assert res["flags"] == []
+    assert any(i.get("note") == "only in new" for i in res["info"])
+
+
+def test_gzip_transparent_and_bad_input_exit_two(tmp_path):
+    rows = [_row("c/s @4k", 1000.0)]
+    plain = _round(tmp_path / "r.json", rows)
+    gz = str(tmp_path / "r2.json.gz")
+    with gzip.open(gz, "wt") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    assert bench_diff.main([plain, gz]) == 0
+    # A bare path whose only form on disk is .gz also resolves.
+    assert bench_diff.main([plain, gz[:-3]]) == 0
+    assert bench_diff.main([plain, str(tmp_path / "missing.json")]) == 2
+    empty = _round(tmp_path / "empty.json", [])
+    assert bench_diff.main([plain, empty]) == 2
+
+
+def test_phaselog_writes_gzip_and_readers_are_transparent(
+        tmp_path, monkeypatch):
+    """The chaos artifact writer (tools/_artifact.py) now emits .json.gz
+    and open_artifact reads either form; sequence numbering sees both
+    extensions so a mixed directory never overwrites."""
+    monkeypatch.setattr(_artifact, "ARTIFACT_DIR", str(tmp_path))
+    log = _artifact.PhaseLog("unit", seed=7, config={"g": 4})
+    log.phase("warm", commits=12)
+    path = log.save("cpu")
+    assert path.endswith("unit_cpu_000.json.gz") and os.path.exists(path)
+    with _artifact.open_artifact(path) as f:
+        doc = json.load(f)
+    assert doc["seed"] == 7 and doc["phases"][0]["phase"] == "warm"
+    # Bare-path read falls back to the .gz sibling.
+    with _artifact.open_artifact(path[:-3]) as f:
+        assert json.load(f)["config"] == {"g": 4}
+    # A legacy uncompressed artifact still occupies its slot.
+    with open(os.path.join(str(tmp_path), "unit_cpu_001.json"),
+              "w") as f:
+        json.dump({}, f)
+    path2 = _artifact.PhaseLog("unit", seed=7, config={}).save("cpu")
+    assert path2.endswith("unit_cpu_002.json.gz")

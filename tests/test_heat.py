@@ -1,0 +1,211 @@
+"""Per-group heat accounting (ISSUE 18).
+
+The device heat lanes (core/types.py HeatState) count cumulative
+per-group activity; the runtime drains them once per tick into the
+decaying host registry (utils/heat.py).  Checked here: the registry's
+delta/decay/idleness math in isolation, and through a live cluster
+that a deterministic Zipf-shaped hot set is identified EXACTLY by the
+/heatmap top-K while the active-set gauge tracks the hot fraction —
+the proof metric for the sparse-tick roadmap item.
+"""
+
+import numpy as np
+import pytest
+
+from rafting_tpu.core.types import EngineConfig
+from rafting_tpu.testkit.harness import LocalCluster
+from rafting_tpu.testkit.openloop import zipf_weights
+from rafting_tpu.utils.heat import (
+    IDLE_BUCKETS, LANES, HeatRegistry, heat_registry_from_env,
+)
+
+CFG_HEAT = EngineConfig(n_groups=8, n_peers=3, log_slots=32, batch=4,
+                        max_submit=4, election_ticks=6,
+                        heartbeat_ticks=2, rpc_timeout_ticks=5,
+                        heat=True)
+
+
+def _lanes(G, **kw):
+    """Cumulative device lanes with only the named groups nonzero."""
+    out = {name: np.zeros(G, np.int64) for name in LANES}
+    for name, pairs in kw.items():
+        for g, v in pairs:
+            out[name][g] = v
+    return out
+
+
+# ---------------------------------------------------- registry math --
+
+
+def test_ingest_deltas_and_totals():
+    r = HeatRegistry(4, half_life_ticks=64, active_window_ticks=8)
+    d = r.ingest(1, **_lanes(4, appended=[(0, 3)], sent=[(1, 5)],
+                             commits=[(0, 2)], reads=[(2, 7)]))
+    assert d == (3, 5, 2, 7)
+    # Cumulative lanes: the same counters again fold a zero delta.
+    d = r.ingest(2, **_lanes(4, appended=[(0, 3)], sent=[(1, 5)],
+                             commits=[(0, 2)], reads=[(2, 7)]))
+    assert d == (0, 0, 0, 0)
+    assert dict(zip(LANES, r.totals.tolist())) == {
+        "appended": 3, "sent": 5, "commits": 2, "reads": 7}
+    # sent is EXCLUDED from the work score (heartbeats would declare
+    # the whole idle fleet hot); appended+commits+reads count.
+    assert r.score[0] == 5.0 and r.score[1] == 0.0 and r.score[2] == 7.0
+
+
+def test_score_decays_by_half_life():
+    r = HeatRegistry(2, half_life_ticks=10, active_window_ticks=100)
+    r.ingest(0, **_lanes(2, appended=[(0, 8)]))
+    assert r.score[0] == 8.0
+    # Decay is lazy — applied when new work arrives, dt=10 → one half.
+    r.ingest(10, **_lanes(2, appended=[(0, 8), (1, 2)]))
+    assert r.score[0] == pytest.approx(4.0)
+    assert r.score[1] == pytest.approx(2.0)
+    # top_k applies the residual decay without mutating the scores.
+    top = r.top_k(2)
+    assert [t["group"] for t in top] == [0, 1]
+    r.ingest(20, **_lanes(2, appended=[(0, 8), (1, 3)]))
+    assert r.score[0] == pytest.approx(2.0)
+
+
+def test_reset_group_prevents_negative_delta():
+    r = HeatRegistry(2, half_life_ticks=64, active_window_ticks=8)
+    r.ingest(1, **_lanes(2, appended=[(0, 9)], commits=[(0, 9)]))
+    assert r.score[0] > 0 and r.last_active[0] == 1
+    # Lane purge: device counters restart at 0 — without the mirror
+    # reset the next drain would fold a -9 delta.
+    r.reset_group(0)
+    assert r.score[0] == 0.0 and r.last_active[0] == -1
+    d = r.ingest(2, **_lanes(2, appended=[(0, 1)], commits=[(0, 1)]))
+    assert d[0] == 1 and d[2] == 1
+    assert r.score[0] == 2.0
+
+
+def test_active_set_window():
+    r = HeatRegistry(4, half_life_ticks=64, active_window_ticks=4)
+    r.ingest(0, **_lanes(4, appended=[(0, 1), (1, 1)]))
+    assert r.active_set_size() == 2
+    # Only group 1 works again; group 0 ages out of the window.
+    r.ingest(6, **_lanes(4, appended=[(0, 1), (1, 3)]))
+    assert r.active_set_size() == 1
+    # Never-active groups never count.
+    assert r.idleness_histogram()["never_active"] == 2
+
+
+def test_idleness_histogram_buckets():
+    r = HeatRegistry(6, half_life_ticks=64, active_window_ticks=64)
+    r.ingest(0, **_lanes(6, appended=[(0, 1), (1, 1), (2, 1)]))
+    r.ingest(3, **_lanes(6, appended=[(0, 1), (1, 1), (2, 1), (3, 1)]))
+    r.ingest(40, **_lanes(6, appended=[(0, 1), (1, 1), (2, 1),
+                                       (3, 2), (4, 1)]))
+    h = r.idleness_histogram()
+    assert h["le_ticks"][:3] == [1, 2, 4] and h["le_ticks"][-1] == "inf"
+    assert sum(h["counts"]) == 5 and h["never_active"] == 1
+    # Lanes are CUMULATIVE: at tick 40 only groups 3 and 4 moved (the
+    # others repeated their old counters → zero delta), so two groups
+    # sit in the ≤1 bucket and the three tick-3 groups at age 37 land
+    # in the ≤64 bucket.
+    assert h["counts"][0] == 2
+    assert h["counts"][h["le_ticks"].index(64)] == 3
+    assert len(h["counts"]) == len(IDLE_BUCKETS) + 1
+
+
+def test_top_k_orders_and_skips_zero_scores():
+    r = HeatRegistry(5, half_life_ticks=64, active_window_ticks=64)
+    r.ingest(1, **_lanes(5, appended=[(2, 9), (4, 3)], reads=[(1, 1)]))
+    top = r.top_k(5)
+    assert [t["group"] for t in top] == [2, 4, 1]
+    assert top[0]["score"] >= top[1]["score"] >= top[2]["score"]
+    assert all(set(t) >= {"group", "score", "appended", "sent",
+                          "commits", "reads", "idle_ticks"} for t in top)
+    assert r.top_k(1) == top[:1]
+    assert r.top_k(0) == []
+
+
+def test_registry_from_env(monkeypatch):
+    monkeypatch.setenv("RAFT_HEAT_HALF_LIFE", "17")
+    monkeypatch.setenv("RAFT_HEAT_WINDOW", "9")
+    r = heat_registry_from_env(3)
+    assert r.half_life == 17.0 and r.window == 9 and r.n_groups == 3
+
+
+def test_snapshot_shape():
+    r = HeatRegistry(4, half_life_ticks=64, active_window_ticks=8)
+    r.ingest(2, **_lanes(4, appended=[(1, 4)], commits=[(1, 4)]))
+    doc = r.snapshot(k=2)
+    assert doc["groups"] == 4 and doc["tick"] == 2
+    assert doc["active_set"] == 1
+    assert doc["totals"] == {"appended": 4, "sent": 0, "commits": 4,
+                             "reads": 0}
+    assert doc["top"][0]["group"] == 1
+    assert doc["idleness"]["never_active"] == 3
+
+
+# ---------------------------------------------- live hot-set proof --
+
+
+def test_cluster_zipf_hot_set_exact(tmp_path, monkeypatch):
+    """Zipf-shaped traffic onto a known hot subset: the /heatmap top-K
+    names the hot set EXACTLY (order by weight) and the active-set
+    gauge tracks the hot fraction once election noise ages out of the
+    window — the direct proof the gauge can drive sparse ticking."""
+    monkeypatch.setenv("RAFT_HEAT_WINDOW", "16")
+    c = LocalCluster(CFG_HEAT, str(tmp_path))
+    try:
+        hot = (1, 3, 6)
+        for g in hot:
+            c.wait_leader(g)
+        # Let the whole fleet's election no-ops age past the window.
+        c.tick(20)
+        # Zipf-shaped load across the hot set: heaviest first.
+        w = zipf_weights(len(hot), 1.2)
+        counts = [max(int(round(x * 12)), 1) for x in sorted(w)[::-1]]
+        assert counts[0] > counts[1] > counts[2] >= 1
+        # Interleave the schedule and end with one submit per hot
+        # group: each submit_via_leader burns a few ticks, so a purely
+        # sequential hot-group order can age the FIRST group past the
+        # recency window before the snapshot — activity order must not
+        # decide membership, only totals decide rank.
+        sched = []
+        for i in range(max(counts)):
+            sched += [g for g, n in zip(hot, counts) if i < n - 1]
+        sched += list(hot)
+        for j, g in enumerate(sched):
+            c.submit_via_leader(g, b"zipf-%d-%d" % (g, j))
+        c.tick(6)
+        node = c.nodes[c.leader_of(hot[0])]
+        snap = node.heatmap_snapshot(k=len(hot))
+        assert snap["active_set"] == len(hot)
+        top = snap["top"]
+        assert [t["group"] for t in top] == list(hot)
+        assert top[0]["score"] > top[1]["score"] > top[2]["score"] > 0
+        for t in top:
+            assert t["idle_ticks"] <= 16
+            assert t["appended"] >= 1 and t["commits"] >= 1
+        # The idleness distribution separates hot from aged-out cold.
+        idle = snap["idleness"]
+        assert idle["never_active"] == 0      # every group elected once
+        cold = CFG_HEAT.n_groups - len(hot)
+        old_mass = sum(n for le, n in zip(idle["le_ticks"],
+                                          idle["counts"])
+                       if le == "inf" or le > 16)
+        assert old_mass >= cold
+        # Metrics fold mirrors the registry totals.
+        assert node.metrics["heat_appended"] >= sum(counts)
+        assert node.metrics["heat_commits"] >= sum(counts)
+        assert node.metrics._gauges["heat_active_set"] == len(hot)
+    finally:
+        c.close()
+
+
+def test_cluster_heat_disabled_is_none(tmp_path):
+    import dataclasses
+    cfg = dataclasses.replace(CFG_HEAT, heat=False)
+    c = LocalCluster(cfg, str(tmp_path))
+    try:
+        c.wait_leader(0)
+        node = c.nodes[c.leader_of(0)]
+        assert node.heat is None
+        assert node.heatmap_snapshot() == {"enabled": False}
+    finally:
+        c.close()

@@ -182,3 +182,80 @@ def test_membership_counters_on_metrics(tmp_path):
         assert node.metrics["membership_changes_committed"] >= 2
     finally:
         c.close()
+
+
+def test_heat_and_hop_metrics_on_exposition(tmp_path, monkeypatch):
+    """ISSUE 18 satellite: the fleet-attribution counters, the
+    heat_active_set gauge and the per-segment hop histograms all render
+    on /metrics and the page passes the strict round-trip validator."""
+    from rafting_tpu.core.types import EngineConfig
+    from rafting_tpu.testkit.harness import LocalCluster
+
+    monkeypatch.setenv("RAFT_LAT_SAMPLE", "1")
+    cfg = EngineConfig(n_groups=4, n_peers=3, log_slots=32, batch=4,
+                       max_submit=4, election_ticks=6, heartbeat_ticks=2,
+                       rpc_timeout_ticks=5, heat=True)
+    c = LocalCluster(cfg, str(tmp_path), pipeline=False)
+    try:
+        c.wait_leader(0)
+        for i in range(4):
+            c.submit_via_leader(0, b"prom-%d" % i)
+        c.tick(8)
+        node = c.nodes[c.leader_of(0)]
+        text = node.metrics.render_prometheus()
+        validate_exposition(text)
+        for name in ("raft_heat_appended_total", "raft_heat_sent_total",
+                     "raft_heat_commits_total", "raft_heat_reads_total",
+                     "raft_heat_active_set",
+                     "raft_hop_tracked_total",
+                     "raft_hop_requests_sent_total",
+                     "raft_hop_echoes_total", "raft_hop_finalized_total",
+                     "raft_hop_dropped_unknown_total"):
+            assert name in text, f"{name} missing from exposition"
+        for seg in ("leader_pack", "wire", "follower_fsync",
+                    "ack_return", "quorum_wait"):
+            assert f"raft_hop_{seg}_s_bucket" in text
+        assert node.metrics["heat_appended"] >= 4
+        assert node.metrics["hop_finalized"] >= 1
+    finally:
+        c.close()
+
+
+def test_hop_metric_cardinality_bounded(tmp_path, monkeypatch):
+    """Cardinality lint: per-peer hop histograms embed the peer in the
+    metric NAME (the strict validator admits only the le label), so the
+    hop family must stay at exactly 5 segments x (1 aggregate + at most
+    P peer series) — a leaked per-span or per-group series would blow
+    the scrape."""
+    from rafting_tpu.core.types import EngineConfig
+    from rafting_tpu.testkit.harness import LocalCluster
+
+    monkeypatch.setenv("RAFT_LAT_SAMPLE", "1")
+    cfg = EngineConfig(n_groups=4, n_peers=3, log_slots=32, batch=4,
+                       max_submit=4, election_ticks=6, heartbeat_ticks=2,
+                       rpc_timeout_ticks=5)
+    c = LocalCluster(cfg, str(tmp_path), pipeline=False)
+    try:
+        c.wait_leader(0)
+        for i in range(6):
+            c.submit_via_leader(0, b"card-%d" % i)
+        c.tick(8)
+        node = c.nodes[c.leader_of(0)]
+        assert node._hops.counts["finalized"] >= 1
+        segs = ("leader_pack", "wire", "follower_fsync", "ack_return",
+                "quorum_wait")
+        hop_hists = [n for n in node.metrics._histograms
+                     if n.startswith("hop_")]
+        assert hop_hists, "no hop histograms observed"
+        P = cfg.n_peers
+        allowed = {f"hop_{s}_s" for s in segs} | {
+            f"hop_{s}_p{p}_s" for s in segs for p in range(P)}
+        assert set(hop_hists) <= allowed
+        assert len(hop_hists) <= len(segs) * (P + 1)
+        # Aggregate + at least one peer series per segment exist.
+        for s in segs:
+            assert f"hop_{s}_s" in hop_hists
+        assert any("_p" in n for n in hop_hists)
+        validate_exposition(node.metrics.render_prometheus())
+    finally:
+        c.close()

@@ -143,3 +143,87 @@ def test_timeline_matches_leader_churn_under_partition(tmp_path):
         assert total_churn >= 0
     finally:
         c.close()
+
+
+CFG_HEAT = EngineConfig(n_groups=4, n_peers=3, log_slots=32, batch=4,
+                        max_submit=4, election_ticks=6,
+                        heartbeat_ticks=2, rpc_timeout_ticks=5,
+                        trace_depth=32, heat=True)
+
+
+def test_heatmap_and_hops_endpoints(tmp_path, monkeypatch):
+    """The fleet-attribution endpoints (ISSUE 18): /heatmap serves the
+    decaying registry document, /hops the hop tracer's, and /latency
+    carries the hops subdocument when tracing is live."""
+    monkeypatch.setenv("RAFT_LAT_SAMPLE", "1")
+    c = LocalCluster(CFG_HEAT, str(tmp_path), pipeline=False)
+    try:
+        c.wait_leader(0)
+        for i in range(4):
+            c.submit_via_leader(0, b"attr-%d" % i)
+        c.tick(8)
+        node = c.nodes[c.leader_of(0)]
+        srv = node.start_observability()
+
+        status, ctype, body = _get(srv.port, "/heatmap")
+        assert status == 200 and ctype.startswith("application/json")
+        doc = json.loads(body)
+        assert doc["enabled"] is True
+        assert doc["groups"] == CFG_HEAT.n_groups
+        assert doc["totals"]["appended"] >= 4
+        assert doc["active_set"] >= 1
+        assert any(t["group"] == 0 for t in doc["top"])
+        # k caps the top list.
+        _, _, body = _get(srv.port, "/heatmap?k=1")
+        assert len(json.loads(body)["top"]) == 1
+
+        status, ctype, body = _get(srv.port, "/hops")
+        assert status == 200 and ctype.startswith("application/json")
+        doc = json.loads(body)
+        assert doc["enabled"] is True
+        assert doc["counts"]["finalized"] >= 1
+        assert doc["segments"]
+
+        # /latency embeds the same hops document.
+        status, _, body = _get(srv.port, "/latency")
+        assert status == 200
+        assert json.loads(body)["hops"]["counts"]["finalized"] >= 1
+    finally:
+        c.close()
+
+
+def test_typed_4xx_errors(cluster):
+    """Hardened error paths (ISSUE 18 satellite): malformed params and
+    unknown paths answer with typed JSON, never a traceback or a bare
+    status line."""
+    srv = cluster.nodes[cluster.leader_of(0)].start_observability()
+
+    # Non-integer param → 400 bad_param.
+    for path in ("/timeline?group=abc", "/heatmap?k=abc"):
+        status, ctype, body = _get(srv.port, path)
+        assert status == 400 and ctype.startswith("application/json")
+        doc = json.loads(body)
+        assert doc["error"] == "bad_param" and "detail" in doc
+
+    # Out-of-range param → 400 param_out_of_range.
+    for path in ("/timeline?group=999", "/timeline?group=-1",
+                 "/heatmap?k=0", "/heatmap?k=99999"):
+        status, _, body = _get(srv.port, path)
+        assert status == 400
+        assert json.loads(body)["error"] == "param_out_of_range"
+
+    # Unknown path → 404 unknown_path listing the served paths.
+    status, _, body = _get(srv.port, "/nope")
+    assert status == 404
+    doc = json.loads(body)
+    assert doc["error"] == "unknown_path"
+    assert "/heatmap?k=N" in doc["paths"] and "/hops" in doc["paths"]
+
+
+def test_heatmap_disabled_document(cluster):
+    """A heatless config still serves /heatmap — enabled: false, so
+    dashboards can probe capability without a 404."""
+    srv = cluster.nodes[cluster.leader_of(0)].start_observability()
+    status, _, body = _get(srv.port, "/heatmap")
+    assert status == 200
+    assert json.loads(body) == {"enabled": False}

@@ -262,3 +262,23 @@ def test_parity_five_nodes():
                        max_submit=2, election_ticks=8, heartbeat_ticks=2,
                        rpc_timeout_ticks=6, pre_vote=True)
     run_parity(11, n_ticks=50, cfg=cfg, drop_p=0.25, part_p=0.15)
+
+
+def test_parity_heat_lanes_under_chaos():
+    """cfg.heat on: the scalar oracle mirrors the device heat lanes
+    (appended / sent / commits / reads) tick-for-tick — under the full
+    drop + partition + crash-restart + clock-stall mix, since activity
+    history is observability state that must survive crash_restart
+    untouched.  assert_state_equal covers every heat.* field; on top of
+    that the lanes must actually accumulate (a run that never moved a
+    counter proves nothing)."""
+    cfg = EngineConfig(n_groups=8, n_peers=3, log_slots=16, batch=4,
+                       max_submit=4, election_ticks=6, heartbeat_ticks=2,
+                       rpc_timeout_ticks=5, pre_vote=True, heat=True)
+    states, _ = run_parity(19, n_ticks=60, cfg=cfg,
+                           crash_p=0.04, stall_p=0.06)
+    final = states[-1]
+    assert final.heat is not None
+    assert int(np.asarray(final.heat.appended).sum()) > 0
+    assert int(np.asarray(final.heat.sent).sum()) > 0
+    assert int(np.asarray(final.heat.commits).sum()) > 0

@@ -1,14 +1,16 @@
 """Shared validation-artifact writer for the tools/ validators.
 
 Every validator run persists its raw evidence — seed, config, per-phase
-numbers, platform, wall-clock — as a committed JSON file under
-``artifacts/``, so on-device results survive as auditable artifacts
+numbers, platform, wall-clock — as a committed gzip-compressed JSON
+file (``.json.gz``) under ``artifacts/``, so on-device results survive
+as auditable artifacts
 instead of prose (the reference's verification ethos is artifact-driven:
 byte-identical output files, /root/reference/README.md:28-33).
 """
 
 from __future__ import annotations
 
+import gzip
 import json
 import os
 import sys
@@ -16,6 +18,18 @@ import time
 
 ARTIFACT_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "artifacts")
+
+
+def open_artifact(path: str, mode: str = "rt"):
+    """Open an artifact transparently: ``.gz`` paths decompress, and a
+    bare ``.json`` path falls back to its ``.json.gz`` sibling when only
+    the compressed form exists (new runs write compressed; committed
+    history may hold either)."""
+    if path.endswith(".gz"):
+        return gzip.open(path, mode)
+    if not os.path.exists(path) and os.path.exists(path + ".gz"):
+        return gzip.open(path + ".gz", mode)
+    return open(path, mode)
 
 
 class PhaseLog:
@@ -39,10 +53,13 @@ class PhaseLog:
         os.makedirs(ARTIFACT_DIR, exist_ok=True)
         stem = f"{self.name}_{platform}"
         seq = 0
-        while os.path.exists(os.path.join(ARTIFACT_DIR,
-                                          f"{stem}_{seq:03d}.json")):
+        # Sequence numbers must not collide with either form —
+        # committed history holds bare .json, new runs write .json.gz.
+        while any(os.path.exists(os.path.join(
+                ARTIFACT_DIR, f"{stem}_{seq:03d}.json{ext}"))
+                for ext in ("", ".gz")):
             seq += 1
-        path = os.path.join(ARTIFACT_DIR, f"{stem}_{seq:03d}.json")
+        path = os.path.join(ARTIFACT_DIR, f"{stem}_{seq:03d}.json.gz")
         doc = {
             "name": self.name,
             "ok": ok,
@@ -54,7 +71,7 @@ class PhaseLog:
             "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             "argv": sys.argv[1:],
         }
-        with open(path, "w") as f:
+        with gzip.open(path, "wt") as f:
             json.dump(doc, f, indent=1, sort_keys=True)
         print(f"[{self.name}] artifact saved: {path}", flush=True)
         return path

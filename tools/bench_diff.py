@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Compare two bench-runtime rounds and flag regressions.
+
+The repo banks every round's durable-runtime numbers as committed
+JSON-lines files (``BENCH_RUNTIME_r*.json``, one document per scale /
+stage).  This CLI diffs two rounds metric-by-metric and flags, per
+scale:
+
+* a **throughput regression**: a durable commits/sec (or ops/sec /
+  reads/sec) line whose value dropped more than ``--threshold``
+  (default 10%) against the older round;
+* a **p999 blowup**: a tail latency (sampled e2e ``p999_s`` when the
+  round carries the latency plane, else the tick-latency ``p99_s``
+  proxy) that grew past ``--p999-factor`` x the older round's
+  (default 2x).
+
+Metrics are matched by their exact ``metric`` string; lines present in
+only one round are reported informationally, never flagged (a new
+stage is not a regression).  Zero dependencies, gzip-transparent
+(``.json`` or ``.json.gz``).
+
+Usage:
+    tools/bench_diff.py OLD.json NEW.json [--threshold 0.10]
+        [--p999-factor 2.0] [--json]
+
+Exit status: 0 = no flags, 1 = at least one regression flagged (so CI
+can gate on it), 2 = unreadable input.
+"""
+
+import argparse
+import gzip
+import json
+import os
+import sys
+
+RATE_UNITS = ("commits/sec", "ops/sec", "reads/sec")
+
+
+def _open(path: str):
+    if path.endswith(".gz"):
+        return gzip.open(path, "rt")
+    if not os.path.exists(path) and os.path.exists(path + ".gz"):
+        return gzip.open(path + ".gz", "rt")
+    return open(path)
+
+
+def load_round(path: str) -> dict:
+    """Parse a JSON-lines bench round into {metric: doc}; non-JSON
+    lines (log noise) are skipped.  Duplicate metric names keep the
+    LAST occurrence (re-runs append)."""
+    docs = {}
+    with _open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or not line.startswith("{"):
+                continue
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(doc, dict) and "metric" in doc:
+                docs[doc["metric"]] = doc
+    return docs
+
+
+def _p999(doc: dict):
+    """Best available tail-latency figure for one metric line: the
+    sampled e2e p999 when the latency plane rode along, else the tick
+    p99 proxy.  Returns (seconds, source) or (None, None)."""
+    lat = doc.get("latency") or {}
+    e2e = lat.get("e2e") or {}
+    if isinstance(e2e, dict) and e2e.get("p999_s"):
+        return float(e2e["p999_s"]), "e2e_p999_s"
+    tick = doc.get("tick_latency") or {}
+    if isinstance(tick, dict) and tick.get("p99_s"):
+        return float(tick["p99_s"]), "tick_p99_s"
+    return None, None
+
+
+def diff(old: dict, new: dict, threshold: float = 0.10,
+         p999_factor: float = 2.0) -> dict:
+    flags, infos = [], []
+    for metric in sorted(set(old) | set(new)):
+        o, n = old.get(metric), new.get(metric)
+        if o is None or n is None:
+            infos.append({"metric": metric,
+                          "note": "only in " + ("new" if o is None
+                                                else "old")})
+            continue
+        try:
+            ov, nv = float(o.get("value", 0)), float(n.get("value", 0))
+        except (TypeError, ValueError):
+            continue
+        row = {"metric": metric, "old": ov, "new": nv}
+        unit = str(n.get("unit", ""))
+        if any(u in unit for u in RATE_UNITS) and ov > 0:
+            ratio = nv / ov
+            row["ratio"] = round(ratio, 3)
+            if ratio < 1.0 - threshold:
+                flags.append({**row, "kind": "throughput_regression",
+                              "drop_pct": round((1 - ratio) * 100, 1)})
+                continue
+        op, osrc = _p999(o)
+        np_, nsrc = _p999(n)
+        if op and np_ and osrc == nsrc and np_ > op * p999_factor:
+            flags.append({**row, "kind": "p999_blowup", "source": osrc,
+                          "old_p999_s": op, "new_p999_s": np_,
+                          "factor": round(np_ / op, 2)})
+            continue
+        infos.append(row)
+    return {"flags": flags, "compared": len(set(old) & set(new)),
+            "info": infos}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("old", help="older round (JSON-lines, .gz ok)")
+    ap.add_argument("new", help="newer round (JSON-lines, .gz ok)")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="flag rate drops beyond this fraction "
+                         "(default 0.10)")
+    ap.add_argument("--p999-factor", type=float, default=2.0,
+                    help="flag tail growth beyond this factor "
+                         "(default 2.0)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the full diff document as JSON")
+    args = ap.parse_args(argv)
+
+    try:
+        old, new = load_round(args.old), load_round(args.new)
+    except OSError as e:
+        print(f"bench_diff: {e}", file=sys.stderr)
+        return 2
+    if not old or not new:
+        print("bench_diff: no metric lines found in "
+              + (args.old if not old else args.new), file=sys.stderr)
+        return 2
+    res = diff(old, new, threshold=args.threshold,
+               p999_factor=args.p999_factor)
+    if args.as_json:
+        print(json.dumps(res, indent=1))
+    else:
+        print(f"compared {res['compared']} shared metrics "
+              f"({len(res['flags'])} flagged)")
+        for f in res["flags"]:
+            if f["kind"] == "throughput_regression":
+                print(f"  REGRESSION {f['drop_pct']}% drop: "
+                      f"{f['metric']} ({f['old']:.0f} -> "
+                      f"{f['new']:.0f})")
+            else:
+                print(f"  P999 BLOWUP {f['factor']}x "
+                      f"({f['source']}): {f['metric']} "
+                      f"({f['old_p999_s']}s -> {f['new_p999_s']}s)")
+    return 1 if res["flags"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -70,8 +70,9 @@ def main(argv=None) -> int:
     lanes = load_dump(args.dump)
     # Latency-plane meta (optional): sampled spans + per-worker
     # utilization ride the artifact's _meta lane, which load_dump's
-    # typed-lane view drops — read the raw JSON for it.
-    with open(args.dump) as f:
+    # typed-lane view drops — read the raw JSON for it
+    # (gzip-transparent: dumps may be .json or .json.gz).
+    with tracelog._open_dump(args.dump) as f:
         meta = json.load(f).get("_meta") or {}
     lat = meta.get("latency") or {}
     spans_by_g = {}

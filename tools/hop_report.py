@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Post-mortem hop-attribution report over a save_dump artifact.
+
+The live half of the hop plane is ``GET /hops`` (runtime/obsrv.py);
+this CLI is the post-mortem half: a run saves its flight-recorder
+rings with ``rafting_tpu.utils.tracelog.save_dump(path, trace,
+meta={"latency": node.latency_snapshot()})`` — the latency snapshot
+embeds the hop tracer's document — and this tool renders the
+cross-node decomposition of ``send_commit``: per-segment percentile
+tables (leader_pack / wire / follower_fsync / ack_return /
+quorum_wait), the same split per peer, the tracer's bookkeeping
+counters, and the recent finalized traces with a reconciliation
+column (sum of segments vs the span's end-to-end send→commit).  Zero
+dependencies — no engine, device, or live process required (same
+contract as tools/latency_report.py).
+
+Usage:
+    tools/hop_report.py DUMP.json[.gz] [--traces N] [--json]
+
+Accepts a full save_dump artifact (hops under ``_meta.latency.hops``),
+a raw ``latency_snapshot()`` document, or a bare ``hops_snapshot()``
+document.  ``--traces`` caps how many recent traces print (default 8;
+0 hides them).  ``--json`` re-emits the raw hops document.
+"""
+
+import argparse
+import gzip
+import json
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+SEGMENTS = ("leader_pack", "wire", "follower_fsync", "ack_return",
+            "quorum_wait")
+
+
+def _open_dump(path: str):
+    """Gzip-transparent read: .gz decompresses; a bare path falls back
+    to its .gz sibling when only the compressed form exists."""
+    if path.endswith(".gz"):
+        return gzip.open(path, "rt")
+    if not os.path.exists(path) and os.path.exists(path + ".gz"):
+        return gzip.open(path + ".gz", "rt")
+    return open(path)
+
+
+def _fmt_s(v) -> str:
+    v = float(v)
+    if v >= 1.0:
+        return f"{v:.3f}s"
+    if v >= 1e-3:
+        return f"{v * 1e3:.3f}ms"
+    return f"{v * 1e6:.1f}us"
+
+
+def _row(label: str, s: dict, out) -> None:
+    print(f"  {label:<18s} n={s.get('n', 0):<7d} "
+          f"p50={_fmt_s(s.get('p50', 0))} "
+          f"p90={_fmt_s(s.get('p90', 0))} "
+          f"p99={_fmt_s(s.get('p99', 0))} "
+          f"p999={_fmt_s(s.get('p999', 0))} "
+          f"max={_fmt_s(s.get('max', 0))}", file=out)
+
+
+def render(hops: dict, traces: int = 8, out=sys.stdout) -> None:
+    counts = hops.get("counts") or {}
+    print("hop tracer: "
+          + " ".join(f"{k}={v}" for k, v in sorted(counts.items())),
+          file=out)
+    print(f"pending={hops.get('pending', 0)} "
+          f"foreign_pending={hops.get('foreign_pending', 0)}", file=out)
+    segments = hops.get("segments") or {}
+    if segments:
+        print("segments (aggregate):", file=out)
+        for seg in SEGMENTS:
+            doc = segments.get(seg)
+            if doc and doc.get("all"):
+                _row(seg, doc["all"], out)
+        peers = sorted({p for doc in segments.values()
+                        for p in (doc.get("peers") or {})})
+        for p in peers:
+            print(f"segments (peer {p}):", file=out)
+            for seg in SEGMENTS:
+                s = (segments.get(seg) or {}).get("peers", {}).get(p)
+                if s:
+                    _row(seg, s, out)
+    recent = hops.get("recent") or []
+    if traces and recent:
+        print(f"recent traces (last {min(traces, len(recent))}):",
+              file=out)
+        for tr in recent[-traces:]:
+            sc = float(tr.get("send_commit_s", 0.0))
+            print(f"  seq {tr.get('seq')} group={tr.get('group')} "
+                  f"idx={tr.get('idx')} tick={tr.get('tick')} "
+                  f"send_commit={_fmt_s(sc)}", file=out)
+            for p, segs in sorted((tr.get("peers") or {}).items()):
+                total = sum(float(segs.get(s, 0.0)) for s in SEGMENTS)
+                parts = " ".join(f"{s}={_fmt_s(segs.get(s, 0.0))}"
+                                 for s in SEGMENTS)
+                recon = (f" (sum={_fmt_s(total)}, "
+                         f"{total / sc * 100:.1f}% of e2e)"
+                         if sc > 0 else f" (sum={_fmt_s(total)})")
+                print(f"    peer {p}: {parts}{recon}", file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dump", help="save_dump artifact, latency_snapshot "
+                                 "document, or hops_snapshot document")
+    ap.add_argument("--traces", type=int, default=8,
+                    help="recent traces to print (0 hides them)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="re-emit the raw hops document as JSON")
+    args = ap.parse_args(argv)
+
+    with _open_dump(args.dump) as f:
+        doc = json.load(f)
+    # Accept a full save_dump artifact (_meta.latency.hops), a raw
+    # latency snapshot (hops), or a bare hops document (segments).
+    meta = doc.get("_meta", doc) if isinstance(doc, dict) else {}
+    lat = meta.get("latency") if isinstance(meta, dict) else None
+    hops = (lat or {}).get("hops") or doc.get("hops")
+    if hops is None and "segments" in doc and "counts" in doc:
+        hops = doc
+    if hops is None:
+        print(f"{args.dump}: no hops document found (save the dump "
+              "with meta={'latency': node.latency_snapshot()} and "
+              "RAFT_HOP_TRACE on)", file=sys.stderr)
+        return 1
+    if args.as_json:
+        print(json.dumps(hops))
+        return 0
+    try:
+        render(hops, traces=args.traces)
+    except BrokenPipeError:
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

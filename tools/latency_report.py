@@ -20,10 +20,22 @@ Usage:
 """
 
 import argparse
+import gzip
 import json
+import os
 import sys
 
 sys.path.insert(0, ".")
+
+
+def _open_dump(path: str):
+    """Gzip-transparent read: .gz decompresses; a bare path falls back
+    to its .gz sibling when only the compressed form exists."""
+    if path.endswith(".gz"):
+        return gzip.open(path, "rt")
+    if not os.path.exists(path) and os.path.exists(path + ".gz"):
+        return gzip.open(path + ".gz", "rt")
+    return open(path)
 
 
 def _fmt_s(v) -> str:
@@ -122,7 +134,7 @@ def main(argv=None) -> int:
                     help="re-emit the raw latency snapshot as JSON")
     args = ap.parse_args(argv)
 
-    with open(args.dump) as f:
+    with _open_dump(args.dump) as f:
         doc = json.load(f)
     # Accept a full save_dump artifact (snapshot under _meta.latency), a
     # bare meta dict, or a raw latency_snapshot() document.
