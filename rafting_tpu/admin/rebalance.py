@@ -171,3 +171,50 @@ class Rebalancer:
                        f"group {g}: new leader")
             drained.append(g)
         return drained
+
+    def evacuate(self, node_id: int,
+                 groups: Optional[Iterable[int]] = None) -> List[int]:
+        """``drain_leaders`` for a DEGRADED node (the admin-driven twin
+        of the node's own health evacuation, runtime/node.py
+        _health_tick): transfer every group's leadership off ``node_id``
+        like a drain, but consult each node's gray-failure scorecard
+        (utils/health.py) and never hand a group to a peer that any
+        scorecard currently marks degraded — evacuating INTO the next
+        gray failure just moves the outage.  Falls back to the plain
+        most-caught-up choice when every candidate looks degraded (a
+        slow leader still beats no leader).  Returns the evacuated
+        group ids."""
+        node = self.nodes[node_id]
+        import numpy as np
+
+        degraded: set = set()
+        for n in self.nodes.values():
+            h = getattr(n, "health", None)
+            if h is not None:
+                degraded |= h.degraded_peers()
+                if h.self_degraded():
+                    degraded.add(h.node_id)
+        led = [int(g) for g in
+               (groups if groups is not None
+                else np.nonzero(node.h_role == LEADER)[0])
+               if node.h_role[g] == LEADER]
+        moved = []
+        for g in led:
+            m = node.membership(g)
+            voters = m["voters"] | m["voters_new"]
+            candidates = [p for p in range(64)
+                          if (voters >> p) & 1 and p != node_id]
+            healthy = [p for p in candidates if p not in degraded]
+            pool = healthy or candidates
+            if not pool:
+                continue
+            target = min(pool, key=lambda p: node.catch_up_gap(g, p))
+            fut = node.transfer_leadership(g, target)
+            try:
+                self._wait_future(fut, f"group {g}: leadership transfer")
+            except Exception:
+                continue
+            self._wait(lambda: self.leader_of(g) not in (node_id, None),
+                       f"group {g}: new leader")
+            moved.append(g)
+        return moved

@@ -65,6 +65,51 @@ class NotLeaderError(RaftError):
         self.leader = leader
 
 
+# Evacuation targets travel inside the refusal message like retry-after
+# hints do, so the forward relay preserves them without a codec change.
+_EVAC_TARGET = re.compile(r"\[target=(\d+)\]")
+
+
+def evac_target_of(exc_or_msg) -> Optional[int]:
+    """Extract a leadership-evacuation target hint from a refusal: the
+    typed attribute when present, else the wire marker embedded in the
+    message.  None = no target known."""
+    t = getattr(exc_or_msg, "target", None)
+    if t is not None:
+        return int(t)
+    m = _EVAC_TARGET.search(str(exc_or_msg))
+    return int(m.group(1)) if m else None
+
+
+class LeadershipEvacuatedError(NotLeaderError):
+    """Submission refused: this node PROACTIVELY handed the group's
+    leadership away because its own health scorecard crossed the
+    degraded threshold (gray failure — slow disk, flapping NIC, shed
+    storm; utils/health.py).  Beyond-reference: the reference's only
+    step-down paths are higher-term discovery and the transfer RPC.
+
+    Subclasses NotLeaderError so every existing redirect path keeps
+    working; the distinct type + ``target`` tell clients this was a
+    deliberate hand-off to a named healthy peer — re-point there in one
+    hop (the leader mirror may lag the transfer), and don't count the
+    refusal against this node's circuit breaker (api/retry.py: routing,
+    not sickness).  The target rides the message as ``[target=N]`` so
+    it survives the forward relay (``evac_target_of`` re-parses it)."""
+
+    def __init__(self, group, leader: Optional[int] = None,
+                 target: Optional[int] = None):
+        super().__init__(group, leader)
+        if target is not None:
+            self.args = (f"group {group}: leadership evacuated "
+                         f"(degraded node) [target={int(target)}]",)
+            self.target: Optional[int] = int(target)
+        else:
+            self.args = (f"group {group}: leadership evacuated "
+                         f"(degraded node) (hint: "
+                         f"{leader if leader is not None else '?'})",)
+            self.target = None
+
+
 class NotReadyError(RaftError):
     """Leader exists but a majority of followers are unhealthy; refuse new
     commands rather than buffer unboundedly (reference NotReadyException +
@@ -197,6 +242,11 @@ def wire_refusal(kind: str, detail: str) -> RaftError:
         exc = OverloadError(detail, retry_after_s=ra)
     elif kind == "NotReadyError":
         exc = NotReadyError(detail)
+    elif kind == "LeadershipEvacuatedError":
+        # Group context is unknown at this layer (the stub's wire parse
+        # special-cases the kind with the lane in hand, like NotLeader);
+        # the evacuation target still survives via the message marker.
+        exc = LeadershipEvacuatedError("?", target=evac_target_of(detail))
     elif kind == "UnavailableError":
         exc = UnavailableError(detail)
     elif kind == "StorageFaultError":

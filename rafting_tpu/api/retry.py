@@ -86,6 +86,10 @@ class CircuitBreaker:
 
     NotLeader/NotReady refusals are NOT failures (a healthy peer saying
     "not me" is routing, not sickness) — the caller decides what counts.
+    LeadershipEvacuated is the same: a degraded node handing leadership
+    to a named healthy peer is the self-healing plane WORKING, and
+    tripping its breaker would punish exactly the right behavior (the
+    stub counts it as routing, api/stub.py _PEER_SICK exclusion).
     """
 
     def __init__(self, trip_after: int = 5, cooldown_s: float = 1.0,

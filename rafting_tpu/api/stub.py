@@ -14,8 +14,9 @@ from concurrent.futures import Future, TimeoutError as _FutTimeout
 from typing import Any, Optional, Union
 
 from .anomaly import (
-    NotLeaderError, ObsoleteContextError, OverloadError, RaftError,
-    WaitTimeoutError, as_refusal, is_refusal, retry_after_of, wire_refusal,
+    LeadershipEvacuatedError, NotLeaderError, ObsoleteContextError,
+    OverloadError, RaftError, WaitTimeoutError, as_refusal, evac_target_of,
+    is_refusal, retry_after_of, wire_refusal,
 )
 from .retry import BreakerBoard, CircuitBreaker, RetryBudget
 
@@ -252,9 +253,14 @@ class RaftStub:
     # transient FROM THE CLUSTER'S view — the shed clears / a healthy
     # replica takes over — but both count against the peer's circuit
     # breaker so a persistently refusing node gets routed around.
+    # LeadershipEvacuatedError is listed EXPLICITLY even though it
+    # subclasses NotLeaderError — membership here is by type NAME, not
+    # isinstance, so the subclass would silently fall through to the
+    # permanent-refusal path otherwise.  It is routing chatter (a
+    # deliberate healthy hand-off), NOT _PEER_SICK.
     _TRANSIENT_REFUSALS = ("NotLeaderError", "NotReadyError",
                            "BusyLoopError", "OverloadError",
-                           "UnavailableError")
+                           "UnavailableError", "LeadershipEvacuatedError")
     # Refusal kinds that mean the PEER is sick (breaker ``failure()``),
     # as opposed to healthy routing chatter (NotLeader/NotReady).
     _PEER_SICK = ("BusyLoopError", "OverloadError", "UnavailableError",
@@ -287,6 +293,10 @@ class RaftStub:
             import time as _time
             overall = _time.monotonic() + total
             retries = 0
+            # One-shot redirect from a LeadershipEvacuated refusal: the
+            # refusing node NAMED the peer it handed the group to, which
+            # beats the leader-hint mirror while the fleet re-points.
+            hint_override: Optional[int] = None
 
             def left() -> float:
                 # Per-attempt cap: never let one blocking wait overrun the
@@ -306,7 +316,10 @@ class RaftStub:
                 # carries one (jittered UP only — retrying before the
                 # server's window cannot see a different decision), else
                 # jittered exponential (0.05s doubling, capped at 0.5s).
-                nonlocal retries
+                nonlocal retries, hint_override
+                tgt = evac_target_of(last_refusal)
+                if tgt is not None and tgt != node.node_id:
+                    hint_override = tgt
                 retries += 1
                 if retries > self.max_redirects:
                     raise last_refusal
@@ -370,7 +383,9 @@ class RaftStub:
                                     backoff(e)
                                     continue
                                 raise
-                        hint = node.leader_hint(lane)
+                        hint, hint_override = (
+                            hint_override if hint_override is not None
+                            else node.leader_hint(lane), None)
                         if hint is not None and hint != node.node_id:
                             break
                         backoff(NotLeaderError(lane, None))
@@ -406,9 +421,16 @@ class RaftStub:
                             br.failure()
                         else:
                             br.success()
-                        exc = (NotLeaderError(lane, hint)
-                               if kind == "NotLeaderError"
-                               else wire_refusal(kind, detail))
+                        if kind == "NotLeaderError":
+                            exc: Exception = NotLeaderError(lane, hint)
+                        elif kind == "LeadershipEvacuatedError":
+                            # Rebuild with the lane in hand (wire_refusal
+                            # has no group context) — backoff() chases
+                            # the embedded [target=N] marker directly.
+                            exc = LeadershipEvacuatedError(
+                                lane, hint, target=evac_target_of(detail))
+                        else:
+                            exc = wire_refusal(kind, detail)
                         if kind in self._TRANSIENT_REFUSALS:
                             backoff(exc)
                             continue

@@ -23,7 +23,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
 
 from .types import (
     EngineConfig, FaultSchedule, HeatState, HostInbox, LogState, Messages,
-    RaftState, StepInfo, TraceState,
+    QuorumContact, RaftState, StepInfo, TraceState,
 )
 
 # RaftState fields with no group axis: per-node scalars and the PRNG key.
@@ -34,14 +34,17 @@ _NODE_GROUP = PS("node", "group")          # [N, G, ...] — trailing dims repli
 _NODE_PEER_GROUP = PS("node", None, "group")  # [N, P, G, ...] message planes
 
 
-def state_pspecs(trace: bool = False, heat: bool = False) -> RaftState:
+def state_pspecs(trace: bool = False, heat: bool = False,
+                 qc: bool = False) -> RaftState:
     """A RaftState-shaped pytree of PartitionSpecs for stacked [N, ...] state.
 
     ``trace`` must match whether the state carries flight-recorder lanes
     (cfg.trace_depth > 0): a None subtree in the state needs a None in the
     spec tree, and recorder lanes are [N, G, D] group-major like every
     per-group lane.  ``heat`` likewise matches cfg.heat — heat lanes are
-    plain [N, G] group-major counters."""
+    plain [N, G] group-major counters — and ``qc`` matches
+    cfg.check_quorum (contact lanes are [N, G, P] / [N, G], group-major
+    like the match matrix)."""
     kw = {f.name: _NODE_GROUP for f in dataclasses.fields(RaftState)}
     for name in _STATE_NODE_ONLY:
         kw[name] = _NODE
@@ -54,6 +57,8 @@ def state_pspecs(trace: bool = False, heat: bool = False) -> RaftState:
     kw["heat"] = HeatState(
         appended=_NODE_GROUP, sent=_NODE_GROUP, commits=_NODE_GROUP,
         reads=_NODE_GROUP) if heat else None
+    kw["qc"] = QuorumContact(
+        heard=_NODE_GROUP, since=_NODE_GROUP) if qc else None
     return RaftState(**kw)
 
 
@@ -63,9 +68,14 @@ def messages_pspecs() -> Messages:
                        for f in dataclasses.fields(Messages)})
 
 
-def info_pspecs() -> StepInfo:
-    return StepInfo(**{f.name: _NODE_GROUP
-                       for f in dataclasses.fields(StepInfo)})
+def info_pspecs(qc: bool = False) -> StepInfo:
+    """``qc`` must match whether the info carries the CheckQuorum lanes
+    (cfg.check_quorum) — None-subtree pairing like :func:`state_pspecs`."""
+    kw = {f.name: _NODE_GROUP for f in dataclasses.fields(StepInfo)}
+    if not qc:
+        kw["cq_stepdown"] = None
+        kw["cq_veto"] = None
+    return StepInfo(**kw)
 
 
 def host_pspecs(durable: bool = False) -> HostInbox:
@@ -127,6 +137,9 @@ def validate_cluster_shapes(cfg: EngineConfig, states: RaftState,
     if states.heat is not None:
         assert states.heat.appended.shape[1:] == (G,), \
             states.heat.appended.shape
+    if states.qc is not None:
+        assert states.qc.heard.shape[1:] == (G, P), states.qc.heard.shape
+        assert states.qc.since.shape[1:] == (G,), states.qc.since.shape
     assert inflight.ae_valid.ndim == 3 and inflight.ae_valid.shape[2] == G, \
         inflight.ae_valid.shape
     assert info.commit.shape[1] == G, info.commit.shape
@@ -151,9 +164,10 @@ def shard_cluster(mesh: Mesh, cfg: EngineConfig, states: RaftState,
             tree, specs)
 
     states = put(states, state_pspecs(trace=states.trace is not None,
-                                      heat=states.heat is not None))
+                                      heat=states.heat is not None,
+                                      qc=states.qc is not None))
     inflight = put(inflight, messages_pspecs())
-    info = put(info, info_pspecs())
+    info = put(info, info_pspecs(qc=info.cq_stepdown is not None))
     conn = jax.device_put(conn, NamedSharding(mesh, CONN_PSPEC))
     submit = jax.device_put(submit, NamedSharding(mesh, SUBMIT_PSPEC))
     return states, inflight, info, conn, submit

@@ -214,8 +214,9 @@ def run_cluster_ticks_blocked(cfg: EngineConfig, n_ticks: int,
 
     st_specs, msg_specs, inf_specs = (
         state_pspecs(trace=states.trace is not None,
-                     heat=states.heat is not None), messages_pspecs(),
-        info_pspecs())
+                     heat=states.heat is not None,
+                     qc=states.qc is not None), messages_pspecs(),
+        info_pspecs(qc=prev_info.cq_stepdown is not None))
     states_b = _to_blocks(states, st_specs, nb, gb)
     inflight_b = _to_blocks(inflight, msg_specs, nb, gb)
     info_b = _to_blocks(prev_info, inf_specs, nb, gb)

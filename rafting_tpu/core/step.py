@@ -32,6 +32,9 @@ Phase order within a tick (messages produced in tick t are delivered in t+1):
   5. InstallSnapshot     — offer handling + completion events from host
   6. AppendEntries resps — leader match/next bookkeeping
   6b. read evidence      — same-term ack receipts/echoes feed the barrier
+  6c. CheckQuorum        — leader with no voter-quorum contact within an
+                           election timeout steps down (cfg.check_quorum;
+                           closes the lease: 8b aborts its pending reads)
   7. timers              — election timeout → PreVote round / new election
                            (voters only; TimeoutNow → immediate candidacy)
   7b. transfer intake    — leadership-transfer requests latch/abort; a
@@ -699,6 +702,57 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
     # The pipeline head never trails the ack base.
     send_next = jnp.maximum(send_next, next_idx)
 
+    # ---- 6c. CheckQuorum step-down (cfg.check_quorum) ---------------------
+    # "Paxos vs Raft" (arXiv:2004.05074) leader stickiness: an inbound-cut
+    # leader hears no higher term — phase 1 can never depose it — yet its
+    # outbound heartbeats keep suppressing every follower's election
+    # timer, so the group is hostage until the cut heals.  Remedy: track
+    # the last-heard tick per peer (any valid inbound RPC counts,
+    # term-independent — even a stale reply proves the link alive) and
+    # step down when one election timeout passes without contact from a
+    # voter quorum (joint: both sets, same §6 rule as every quorum).
+    # Placement before 7b/8/8b makes the containment automatic: the
+    # pending transfer aborts (7b keep_x), submissions are refused
+    # (phase 8 role gate), and — the safety-critical part — phase 8b's
+    # keep_reads drops the pending lease reads AND zeroes read_evid, so a
+    # deposed-but-unaware leader can neither strand writes nor serve
+    # stale reads off a dead lease.  The stepped-down node re-arms its
+    # election timer and campaigns through PreVote, which cannot disturb
+    # a healthy majority's new leader (speculative terms never bump).
+    qc = s.qc
+    if cfg.check_quorum:
+        from ..ops.quorum import contact_quorum
+        heard_any = (inbox.ae_valid | inbox.aer_valid | inbox.rv_valid
+                     | inbox.rvr_valid | inbox.is_valid | inbox.isr_valid
+                     | inbox.tn_valid).T & active[:, None] & ~self_hot
+        heard = jnp.where(heard_any, now, qc.heard)
+        # The window anchors at election win; a due check that passes
+        # advances it (fresh contact must then arrive within the NEXT
+        # window — etcd's recent-active reset, vectorized).
+        since = jnp.where(vote_win, now, qc.since)
+        cq_due = active & (role == LEADER) \
+            & (now - since >= cfg.election_ticks)
+        cq_ok = contact_quorum(voters1, vnew1, me, heard, since)
+        cq_down = cq_due & ~cq_ok
+        since = jnp.where(cq_due & cq_ok, now, since)
+        role = jnp.where(cq_down, FOLLOWER, role)
+        leader_id = jnp.where(cq_down, NIL, leader_id)
+        elect_dl = jnp.where(cq_down, now + rand_to, elect_dl)
+        qc = qc.replace(heard=heard, since=since)
+        # Vetoed lease reads: everything pending in the FIFO at the
+        # moment of step-down (8b reads the same s.rq_* and will abort
+        # them via keep_reads — this lane just counts what was saved).
+        K_cq = cfg.read_slots
+        jcol = jnp.arange(K_cq, dtype=I32)[None, :]
+        pend_slot = jnp.remainder(s.rq_head[:, None] + jcol, K_cq)
+        pend_n = jnp.where(jcol < s.rq_len[:, None],
+                           jnp.take_along_axis(s.rq_n, pend_slot, axis=1),
+                           0).sum(axis=1)
+        cq_veto = jnp.where(cq_down, pend_n, 0)
+    else:
+        cq_down = None
+        cq_veto = None
+
     # ---- 7. timers ---------------------------------------------------------
     # (reference RaftRoutine.electionTimeout:65-77 -> Follower.onTimeout:
     # 156-168: PreVote round if enabled, else direct candidacy; candidate
@@ -1223,6 +1277,7 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         xfer_to=xfer_to, xfer_dl=xfer_dl,
         trace=trace,
         heat=heat,
+        qc=qc,
     )
     outbox = Messages(
         ae_valid=out_ae_valid, ae_term=out_ae_term,
@@ -1261,5 +1316,6 @@ def node_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         conf_word=w2, conf_idx=cidx2, conf_pending=cidx2 > commit,
         xfer_fired=xfer_fire, xfer_abort=xfer_abort,
         debug_viol=debug_viol,
+        cq_stepdown=cq_down, cq_veto=cq_veto,
     )
     return new_state, outbox, info

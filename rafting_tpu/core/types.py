@@ -195,6 +195,21 @@ class EngineConfig:
                                   #     pytree and compiled step are bit-
                                   #     identical to a heatless build,
                                   #     same contract as trace_depth.
+    check_quorum: bool = False    # CheckQuorum step-down ("Paxos vs
+                                  #     Raft", arXiv:2004.05074 §leader
+                                  #     stickiness): a leader that has not
+                                  #     heard from a voter quorum within
+                                  #     one election timeout steps down to
+                                  #     follower, closing the read-lease
+                                  #     window and aborting pending lease
+                                  #     reads — the gray-failure remedy
+                                  #     for asymmetric inbound-only cuts,
+                                  #     which a higher-term step-down can
+                                  #     never reach (the cut leader hears
+                                  #     no terms at all).  Adds the
+                                  #     QuorumContact lanes; False keeps
+                                  #     the subtree None (same zero-cost-
+                                  #     when-off contract as trace/heat).
 
     def __post_init__(self):
         assert self.n_peers >= 1
@@ -335,6 +350,32 @@ class HeatState:
         return cls(appended=z(), sent=z(), commits=z(), reads=z())
 
 
+@struct.dataclass
+class QuorumContact:
+    """Per-group quorum-contact lanes (cfg.check_quorum).
+
+    ``heard[g, p]`` is the own-clock tick of the last VALID inbound RPC
+    from peer p (any of the seven kinds, term-independent: even a stale
+    reply proves the link and the peer alive).  ``since[g]`` anchors the
+    contact window: set at election win, advanced each time a due check
+    passes.  A leader whose window has run one election timeout without a
+    voter quorum of ``heard >= since`` steps down (core/step.py phase
+    6c).  Unlike trace/heat these lanes ARE read back by the step — but
+    only by the CheckQuorum phase itself; they are volatile (reset by
+    crash_restart like every liveness timer) and None when disabled, so a
+    ``check_quorum=False`` build compiles bit-identically to the seed.
+    """
+
+    heard: jax.Array   # [G, P] int32 — own-clock tick of last contact (0 never)
+    since: jax.Array   # [G] int32 — contact-window anchor (0 = not leading yet)
+
+    @classmethod
+    def empty(cls, n_groups: int, n_peers: int) -> "QuorumContact":
+        # Two distinct buffers (donation: never alias donated leaves).
+        return cls(heard=jnp.zeros((n_groups, n_peers), I32),
+                   since=jnp.zeros((n_groups,), I32))
+
+
 def trace_append(tr: TraceState, mask: jax.Array, kind: int,
                  tick, term, aux) -> TraceState:
     """Branchless masked append of one event kind across all groups.
@@ -473,6 +514,10 @@ class RaftState:
     # disabled builds compile bit-identical programs.
     heat: Any = None          # Optional[HeatState]
 
+    # Quorum-contact lanes (cfg.check_quorum).  Same None-subtree
+    # contract: a build without CheckQuorum compiles bit-identically.
+    qc: Any = None            # Optional[QuorumContact]
+
 
 @struct.dataclass
 class FaultSchedule:
@@ -556,8 +601,15 @@ def crash_restart(cfg: EngineConfig, s: "RaftState") -> "RaftState":
     if trace is not None:
         trace = trace_append(trace, s.active, TR_CRASH_RESTART,
                              s.now, s.term, s.log.last)
+    # Quorum-contact lanes are volatile like every liveness timer: a
+    # rebooted node re-earns contact evidence from scratch.
+    qc = s.qc
+    if qc is not None:
+        qc = qc.replace(heard=jnp.zeros_like(qc.heard),
+                        since=jnp.zeros_like(qc.since))
     return s.replace(
         trace=trace,
+        qc=qc,
         rng=rng,
         role=z(G),
         leader_id=jnp.full((G,), NIL, I32),
@@ -865,6 +917,15 @@ class StepInfo:
     debug_viol: jax.Array     # [G] int32 — in-kernel invariant violation code
                               #   (0 = ok; codes in step.py DEBUG_CODES).
                               #   Always zeros unless cfg.debug_checks.
+    # CheckQuorum outputs (cfg.check_quorum; None-subtree when off so the
+    # info pytree matches a build without the feature).
+    cq_stepdown: Any = None   # Optional[[G] bool] — leader stepped down
+                              #   this tick for lack of voter-quorum
+                              #   contact within one election timeout
+    cq_veto: Any = None       # Optional[[G] int32] — individual pending
+                              #   lease reads vetoed by that step-down
+                              #   (the reads a deposed-but-unaware leader
+                              #   would otherwise have served stale)
 
     @classmethod
     def empty(cls, cfg: EngineConfig) -> "StepInfo":
@@ -889,6 +950,11 @@ class StepInfo:
             xfer_fired=jnp.zeros((G,), jnp.bool_),
             xfer_abort=jnp.zeros((G,), jnp.bool_),
             debug_viol=z(),
+            # Present iff the feature is on: the scan carry's pytree
+            # structure must match node_step's output structure.
+            cq_stepdown=(jnp.zeros((G,), jnp.bool_)
+                         if cfg.check_quorum else None),
+            cq_veto=(z() if cfg.check_quorum else None),
         )
 
 
@@ -962,4 +1028,5 @@ def init_state(cfg: EngineConfig, node_id: int, seed: int = 0,
         trace=(TraceState.empty(G, cfg.trace_depth)
                if cfg.trace_depth else None),
         heat=(HeatState.empty(G) if cfg.heat else None),
+        qc=(QuorumContact.empty(G, P) if cfg.check_quorum else None),
     )

@@ -287,6 +287,29 @@ def read_barrier_release(voters, voters_new, me, read_evid, rq_stamp,
     return rel.sum(axis=1).astype(I32), (rel * n).sum(axis=1).astype(I32)
 
 
+def contact_quorum(voters, voters_new, me, heard, since):
+    """CheckQuorum contact test for every group at once: has a majority of
+    the VOTERS — and, while joint, of ``voters_new`` too (§6: leadership
+    liveness is a joint decision like any other quorum) — been heard from
+    at/after the window anchor ``since``?
+
+    ``heard`` is [G, P] (own-clock tick of the last valid inbound RPC per
+    peer), ``since`` [G].  Self always counts (a node is always in
+    contact with itself — the single-voter group is the degenerate case);
+    learner contact never does.  The same masked-popcount shape as
+    :func:`read_barrier_release` — only the per-peer flag differs.
+    Returns [G] bool.
+    """
+    P = heard.shape[1]
+    self_hot = (jnp.arange(P, dtype=I32) == me)[None, :]
+    flags = (heard >= since[:, None]) | self_hot                # [G, P]
+    vb = _bits(voters, P)
+    nb = _bits(voters_new, P)
+    ok_v = (flags & vb).sum(axis=1) >= vb.sum(axis=1) // 2 + 1
+    ok_n = (flags & nb).sum(axis=1) >= nb.sum(axis=1) // 2 + 1
+    return ok_v & ((voters_new == 0) | ok_n)
+
+
 def quorum_commit(cfg, match_full, log, commit, own_from, can_lead,
                   voters, voters_new):
     """Dispatch: the legacy fixed-majority baseline when

@@ -60,13 +60,14 @@ from ..transport.codec import (
     EAGER_KINDS, KIND_FIELDS, assemble_slice, pack_hops, pack_kind_section,
 )
 from ..api.anomaly import (
-    BatchAbortedError, BusyLoopError, NotLeaderError, NotReadyError,
-    ObsoleteContextError, OverloadError, StorageFaultError,
-    UnavailableError, as_refusal,
+    BatchAbortedError, BusyLoopError, LeadershipEvacuatedError,
+    NotLeaderError, NotReadyError, ObsoleteContextError, OverloadError,
+    StorageFaultError, UnavailableError, as_refusal,
 )
 from .admission import admission_from_env
 from .txn import txn_plane_from_env
 from ..log.wal import WalNoSpace, WalSyncError
+from ..utils.health import health_from_env
 from ..utils.heat import heat_registry_from_env
 from ..utils.latency import (
     ACKED, FSYNCED, HOP_ECHO, HOP_REQUEST, OFFERED, SENT, SERVED, STAGED,
@@ -724,6 +725,31 @@ class RaftNode:
                        "hop_foreign_expired"):
                 self.metrics[_c] += 0
             self.transport.on_hops = self._on_hops
+        # Gray-failure self-healing plane (utils/health.py): decayed
+        # per-peer + self scorecards fed each tick from the hop
+        # histograms, the storage-fault plane, the transport and the
+        # admission controller; the CheckQuorum contact lanes feed
+        # last-contact at an admin cadence.  A self-degraded node
+        # EVACUATES leadership (rate-limited, never to a degraded
+        # peer) instead of waiting for the device-side 6c step-down.
+        # RAFT_HEALTH=0 disables the whole plane.
+        self.health = health_from_env(cfg.n_peers, node_id)
+        for _c in ("checkquorum_stepdowns", "leader_evacuations",
+                   "lease_vetoes"):
+            self.metrics[_c] += 0
+        if self.health is not None:
+            self.metrics.gauge("health_self_score", 0.0)
+            self.metrics.gauge("health_self_degraded", 0)
+            self.metrics.gauge("health_degraded_peers", 0)
+        # Groups this node evacuated: group -> (target, expiry tick).
+        # Read by _refusal to return the typed LeadershipEvacuated
+        # refusal (api/anomaly.py) while the fleet re-points.
+        self._evacuated: Dict[int, Tuple[int, int]] = {}
+        self._evac_cooldown = int(os.environ.get(
+            "RAFT_EVAC_COOLDOWN_TICKS", str(8 * cfg.election_ticks)))
+        self._evac_groups_per_round = int(
+            os.environ.get("RAFT_EVAC_GROUPS", "8"))
+        self._evac_next_ok = 0
         # Flight-recorder drain (cfg.trace_depth > 0): per-group decoded
         # timelines + labeled metrics (elections by cause, leader churn)
         # harvested from the device event rings each tick.  Inert when
@@ -1205,6 +1231,13 @@ class RaftNode:
             return as_refusal(ObsoleteContextError(f"group {group} closed"))
         if self.h_role[group] != LEADER:
             hint = int(self.h_leader[group])
+            ev = self._evacuated.get(group)
+            if ev is not None and self.ticks < ev[1]:
+                # Health-driven hand-off: the typed refusal carries the
+                # evacuation target so clients re-point in one hop even
+                # before the leader mirror catches up (api/anomaly.py).
+                return as_refusal(LeadershipEvacuatedError(
+                    group, None if hint == NIL else hint, target=ev[0]))
             return as_refusal(
                 NotLeaderError(group, None if hint == NIL else hint))
         if not self.h_ready[group]:
@@ -1343,6 +1376,9 @@ class RaftNode:
             # spans — after harvest so a span retired this tick already
             # carries its outcome.
             self._hops.fold(self.metrics)
+        # Health scorecards last: the fold above just refreshed the hop
+        # histograms this tick's peer scoring reads.
+        self._health_tick()
         self.profiler.after_tick()
         return ctx.info
 
@@ -1374,6 +1410,95 @@ class RaftNode:
                 folded[i] = cur[i]
         m.gauge("admission_level", round(adm.level, 4))
         m.gauge("admission_shedding", 1 if adm.overloaded else 0)
+
+    # ------------------------------------------------- tick: health plane
+
+    def _health_tick(self) -> None:
+        """Per-tick gray-failure scorecard feed + leadership evacuation
+        (tick thread only).  The registry folds this tick's self signals
+        (slow-I/O watchdog, stripe quarantine, ENOSPC backpressure,
+        reconnects, admission shed level) and the hop histograms' per-
+        peer windowed deltas; when the SELF score crosses the degraded
+        threshold, up to ``RAFT_EVAC_GROUPS`` led groups are handed to
+        their most caught-up non-degraded voter via the §3.10 transfer
+        plane — proactive step-down while this node can still replicate,
+        instead of waiting to become the fleet's slowest quorum member.
+        Rate-limited by ``RAFT_EVAC_COOLDOWN_TICKS`` so a flapping score
+        cannot thrash leadership."""
+        h = self.health
+        if h is None:
+            return
+        adm = self.admission
+        h.ingest(self.ticks, self.metrics,
+                 io_slow=self._io_slow,
+                 poisoned_stripes=len(self._poisoned_stripes),
+                 backpressure=self._io_backpressure,
+                 admission_level=adm.level if adm.enabled else 0.0)
+        # Contact feed from the device qc lanes (max over groups -> [P]
+        # last-heard ticks), at an admin cadence like catch_up_gap.
+        if self.state.qc is not None and self.ticks % 16 == 0:
+            heard = np.asarray(jax.device_get(self.state.qc.heard))
+            h.note_contact(heard.max(axis=0))
+        # Expired evacuation markers age out (the fleet has re-pointed).
+        for g in [g for g, (_, exp) in self._evacuated.items()
+                  if self.ticks >= exp]:
+            del self._evacuated[g]
+        bad = h.degraded_peers()
+        m = self.metrics
+        m.gauge("health_self_score", round(h._decayed(h.self_score), 4))
+        m.gauge("health_self_degraded", int(h.self_degraded()))
+        m.gauge("health_degraded_peers", len(bad))
+        if not h.self_degraded() or self.ticks < self._evac_next_ok:
+            return
+        led = np.nonzero(self.h_role == LEADER)[0]
+        if led.size == 0:
+            return
+        from ..core.types import conf_new_of, conf_voters_of
+
+        moved = 0
+        for g in led:
+            g = int(g)
+            if moved >= self._evac_groups_per_round:
+                break
+            with self._member_lock:
+                busy = g in self._xfer_pending
+            if busy or g in self._evacuated:
+                continue
+            w = int(self.h_conf_word[g])
+            vmask = conf_voters_of(w) | conf_new_of(w)
+            cand = [p for p in range(self.cfg.n_peers)
+                    if ((vmask >> p) & 1) and p != self.node_id
+                    and p not in bad]
+            if not cand:
+                continue   # nowhere healthy to go — stay and serve
+            target = min(cand, key=lambda p: self.catch_up_gap(g, p))
+            fut = self.transfer_leadership(g, target)
+            if fut.done() and fut.exception() is not None:
+                continue   # refused (raced a role change) — not an evac
+            self._evacuated[g] = (target,
+                                  self.ticks + 8 * self.cfg.election_ticks)
+            m["leader_evacuations"] += 1
+            h.note_evacuation(g, target)
+            moved += 1
+        if moved:
+            self._evac_next_ok = self.ticks + self._evac_cooldown
+            log.warning(
+                "node %d degraded (score %.2f): evacuated %d group(s)",
+                self.node_id, h._decayed(h.self_score), moved)
+
+    def health_snapshot(self) -> dict:
+        """The /healthz ``peers`` block (runtime/obsrv.py): per-peer and
+        self scorecards, degraded flags, contact ages, evacuation audit.
+        Snapshot reads only — safe off the tick thread (same contract as
+        /metrics)."""
+        if self.health is None:
+            return {"enabled": False}
+        doc = {"enabled": True}
+        doc.update(self.health.snapshot())
+        doc["evacuated_groups"] = {
+            str(g): {"target": t, "expiry_tick": e}
+            for g, (t, e) in sorted(self._evacuated.items())}
+        return doc
 
     # ------------------------------------------------------- tick: dispatch
 
@@ -1656,6 +1781,19 @@ class RaftNode:
             if d_rd:
                 m["heat_reads"] += d_rd
             m.gauge("heat_active_set", self.heat.active_set_size())
+
+        # -- CheckQuorum fold ------------------------------------------------
+        # Device 6c step-downs (a leader lost voter-quorum contact) and
+        # the lease reads they vetoed, folded into counters so a gray
+        # failure is visible on the ordinary /metrics page.  None
+        # subtrees when cfg.check_quorum is off.
+        if h_info.cq_stepdown is not None:
+            n_down = int(np.asarray(h_info.cq_stepdown).sum())
+            n_veto = int(np.asarray(h_info.cq_veto).sum())
+            if n_down:
+                self.metrics["checkquorum_stepdowns"] += n_down
+            if n_veto:
+                self.metrics["lease_vetoes"] += n_veto
 
         self.ticks += 1
         self.metrics.gauge("groups_active", int(self.h_active.sum()))

@@ -195,6 +195,11 @@ class ObservabilityServer:
         overload = adm.snapshot() if adm is not None else {
             "enabled": False, "shedding": False}
         overload["degraded"] = bool(overload.get("shedding", False))
+        # Gray-failure scorecards (utils/health.py): per-peer + self
+        # decayed health, degraded flags, evacuation audit.  A degraded
+        # self is DEGRADED, not unhealthy — the node is actively handing
+        # leadership away; weigh it down, don't eject it.
+        peers = n.health_snapshot()
         return {
             "ok": True,
             "node_id": int(n.node_id),
@@ -205,6 +210,7 @@ class ObservabilityServer:
             "storage": storage,
             "latency": latency,
             "overload": overload,
+            "peers": peers,
             "trace_depth": int(n.cfg.trace_depth),
             "uptime_s": round(time.monotonic() - self._t0, 3),
         }

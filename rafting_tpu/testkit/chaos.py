@@ -49,9 +49,9 @@ from .harness import LocalCluster, free_ports
 from .history import History
 
 __all__ = [
-    "ChaosEvent", "plan_chaos", "timeline_json", "ChaosConductor",
-    "StubHost", "make_recording_stub", "KVWorkload", "TransferWorkload",
-    "ProcCluster",
+    "ChaosEvent", "plan_chaos", "plan_leader_isolate", "timeline_json",
+    "ChaosConductor", "StubHost", "make_recording_stub", "KVWorkload",
+    "TransferWorkload", "ProcCluster",
 ]
 
 
@@ -172,6 +172,38 @@ def plan_chaos(n_peers: int, n_ticks: int, seed: int = 0, *,
     return tuple(events)
 
 
+def plan_leader_isolate(n_ticks: int, seed: int = 0, *,
+                        group: int = 0, period: int = 40,
+                        dur: int = 25) -> Tuple[ChaosEvent, ...]:
+    """Compile the GRAY-FAILURE nemesis: periodically cut every link
+    INTO ``group``'s current leader while its outbound links stay up.
+
+    This is the asymmetric fault CheckQuorum exists for (tests/
+    test_checkquorum.py): the victim's heartbeats still reach — and
+    keep suppressing — every follower's election timer, but it hears no
+    acks and no higher term, so neither phase-1 step-down nor a normal
+    election can ever fire.  Without ``cfg.check_quorum`` the group is
+    hostage for the whole window; with it the leader steps itself down
+    within an election timeout and the healthy majority re-elects.
+
+    The victim is resolved AT APPLY TIME (the conductor's
+    ``_leader_node``), not at plan time — after the first step-down a
+    later period isolates whoever leads NOW, so the nemesis keeps
+    biting across re-elections.  Each cut schedules its heal ``dur``
+    ticks later.  Pure function of its arguments (the timeline is
+    replayable; only the victim binding is runtime state, and the
+    conductor's ``applied`` audit records who it hit)."""
+    events: List[ChaosEvent] = []
+    rng = Random(seed)
+    for t in range(period, n_ticks - dur, period):
+        jitter = rng.randrange(0, max(period // 4, 1))
+        events.append(ChaosEvent(t + jitter, "leader_isolate",
+                                 args=(group,)))
+        events.append(ChaosEvent(t + jitter + dur, "heal"))
+    events.sort(key=lambda e: (e.tick, e.kind, e.a, e.b))
+    return tuple(events)
+
+
 # --------------------------------------------------------------- conductor --
 
 class ChaosConductor:
@@ -201,9 +233,26 @@ class ChaosConductor:
 
     def _apply(self, ev: ChaosEvent) -> None:
         c, f = self.cluster, self.cluster.faults
+        extra: dict = {}
         try:
             if ev.kind == "asym_cut":
                 f.set_link(ev.a, ev.b, False)
+            elif ev.kind == "leader_isolate":
+                # Gray failure: inbound-only cut of the group's CURRENT
+                # leader — its outbound heartbeats keep flowing (that is
+                # the whole point; LinkFaults.isolate cuts both ways and
+                # would let ordinary elections handle it).  Victim is
+                # resolved now and recorded in the audit.
+                g = int(ev.args[0])
+                node = self._leader_node(g)
+                if node is None:
+                    raise RuntimeError(f"group {g} has no leader to "
+                                       "isolate")
+                lead = node.node_id
+                for o in range(c.cfg.n_peers):
+                    if o != lead:
+                        f.set_link(o, lead, False)
+                extra["victim"] = int(lead)
             elif ev.kind == "part":
                 f.partition([list(s) for s in ev.args])
             elif ev.kind == "flaky":
@@ -247,7 +296,7 @@ class ChaosConductor:
                 full = (1 << c.cfg.n_peers) - 1
                 if node is not None:
                     node.change_membership(g, full, 0)
-            self.applied.append({"t": self.t, **ev.to_dict()})
+            self.applied.append({"t": self.t, **ev.to_dict(), **extra})
         except AssertionError:
             raise            # split-brain oracle must fail loudly
         except Exception as e:

@@ -191,6 +191,13 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         ht_com = s["heat.commits"].copy()
         ht_rd = s["heat.reads"].copy()
 
+    # Quorum-contact lanes (cfg.check_quorum): the scalar mirror of the
+    # kernel's CheckQuorum phase 6c.
+    has_qc = state.qc is not None
+    if has_qc:
+        qc_heard = s["qc.heard"].copy()
+        qc_since = s["qc.since"].copy()
+
     old_term = term.copy()
     old_voted = voted.copy()
     old_last = last.copy()
@@ -238,6 +245,9 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
         "conf_word": zi(G), "conf_idx": zi(G), "conf_pending": zb(G),
         "xfer_fired": zb(G), "xfer_abort": zb(G),
     }
+    if has_qc:
+        info["cq_stepdown"] = zb(G)
+        info["cq_veto"] = zi(G)
 
     for g in range(G):
         log = _Log(ring[g], cring[g], int(base[g]), int(base_term[g]),
@@ -558,6 +568,40 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
             # lease evidence is untrustworthy (kernel applies the same
             # zeroing after the evidence store).
             read_evid[g, :] = 0
+
+        # ---- 6c. CheckQuorum step-down (kernel phase 6c) ------------------
+        # Any valid inbound RPC from p (term-independent) refreshes the
+        # contact lane; the window anchors at election win and advances
+        # when a due check passes.  A due leader without a voter-quorum
+        # of fresh contact steps down — phase 8b then drops its pending
+        # lease reads and zeroes read_evid via keep_reads.
+        if has_qc:
+            for p in range(P):
+                if p == me or not active[g]:
+                    continue
+                if any(bool(ib[k][p, g]) for k in
+                       ("ae_valid", "aer_valid", "rv_valid", "rvr_valid",
+                        "is_valid", "isr_valid", "tn_valid")):
+                    qc_heard[g, p] = now
+            if vote_win:
+                qc_since[g] = now
+            cq_due = (active[g] and role[g] == LEADER
+                      and now - int(qc_since[g]) >= cfg.election_ticks)
+            if cq_due:
+                flags = [p == me or int(qc_heard[g, p]) >= int(qc_since[g])
+                         for p in range(P)]
+                if _dual_quorum(flags, voters1, vnew1):
+                    qc_since[g] = now
+                else:
+                    # Count the pending lease reads this step-down vetoes
+                    # BEFORE 8b clears the FIFO.
+                    info["cq_stepdown"][g] = True
+                    info["cq_veto"][g] = sum(
+                        int(rq_n[g, (int(rq_head[g]) + j) % K])
+                        for j in range(int(rq_len[g])))
+                    role[g] = FOLLOWER
+                    leader_id[g] = NIL
+                    elect_dl[g] = now + rand_to[g]
 
         # ---- 7. timers -----------------------------------------------------
         # (reference Follower.onTimeout:156-168, Candidate.onTimeout:82-88.)
@@ -969,4 +1013,6 @@ def oracle_step(cfg: EngineConfig, state: RaftState, inbox: Messages,
             "heat.appended": ht_app, "heat.sent": ht_sent,
             "heat.commits": ht_com, "heat.reads": ht_rd,
         })
+    if has_qc:
+        new_state.update({"qc.heard": qc_heard, "qc.since": qc_since})
     return new_state, out, info

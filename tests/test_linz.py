@@ -236,3 +236,137 @@ def test_history_unpaired_invoke_is_info_forever():
     (op,) = h.ops()
     assert op.status == "info" and math.isinf(op.resp_seq)
     assert linz.check(h).ok
+
+
+# ----------------------------------------- the gray-failure nemesis --
+#
+# Integration tier: the leader_isolate nemesis (testkit/chaos.py) cuts
+# every link INTO a group's leader while its outbound heartbeats keep
+# suppressing follower timers — the fault CheckQuorum exists for.  One
+# honest note on what the lease CAN'T do wrong here: this engine's
+# lease evidence is ACK-RECEIPT based (a leader extends its lease only
+# from acks it actually hears), so an inbound cut starves the lease
+# rather than letting it serve stale reads — the CQ-off failure mode
+# is UNAVAILABILITY (a hostage group), not a linearizability
+# violation.  The CQ-on run is therefore the load-bearing safety
+# proof for the new 6c transition: step-down + cq_veto + re-election
+# under concurrent lease reads must leave a linearizable history, and
+# the group must keep committing.  tools/chaos_run.py carries the
+# matching soak + committed counterexample artifact.
+
+def test_leader_isolate_lease_linearizable_and_live(tmp_path):
+    """check_quorum=True under repeated inbound-only leader cuts: the
+    6c step-down fires (counter proof), the healthy majority re-elects,
+    lease-read clients see a linearizable history, and goodput survives
+    the cuts (ok ops keep landing)."""
+    import os as _os
+    from rafting_tpu.core.types import EngineConfig as _EC
+    from rafting_tpu.machine.kv_machine import KVMachineProvider
+    from rafting_tpu.testkit.chaos import (
+        ChaosConductor, KVWorkload, plan_leader_isolate)
+    from rafting_tpu.testkit.harness import LocalCluster
+    from rafting_tpu.testkit.history import History
+
+    cfg = _EC(n_groups=3, n_peers=3, log_slots=64, batch=8, max_submit=8,
+              election_ticks=10, heartbeat_ticks=3, rpc_timeout_ticks=8,
+              read_lease=True, check_quorum=True)
+    root = str(tmp_path)
+    cluster = LocalCluster(
+        cfg, root, seed=13,
+        provider_factory=lambda i: KVMachineProvider(
+            _os.path.join(root, f"node{i}", "kv")))
+    try:
+        for g in range(cfg.n_groups):
+            cluster.wait_leader(g)
+        history = History()
+        # dur=25 > 2 election timeouts: every cut outlives the step-down
+        # bound, so a surviving leader would be a real regression.
+        events = plan_leader_isolate(160, seed=13, group=1,
+                                     period=50, dur=25)
+        conductor = ChaosConductor(cluster, events)
+        load = KVWorkload(cluster, history, group=1, clients=3, seed=13)
+        load.start()
+        conductor.run(extra_ticks=40, tick_sleep=0.002)
+        load.stop()
+        load.join(tick_fn=conductor.step)
+        conductor.finish()
+        hits = [ev for ev in conductor.applied
+                if ev["kind"] == "leader_isolate" and "victim" in ev]
+        assert hits, f"nemesis never landed: {conductor.applied}"
+        stepdowns = sum(
+            n.metrics._counters.get("checkquorum_stepdowns", 0)
+            for n in cluster.nodes.values())
+        assert stepdowns >= 1, \
+            "no CheckQuorum step-down under an inbound-only leader cut"
+        counts = history.counts()
+        assert counts["ok"] >= 10, f"workload starved: {counts}"
+        res = linz.check(history)
+        assert res.ok, res.render()
+    finally:
+        cluster.close()
+
+
+def test_leader_isolate_hostage_when_check_quorum_off(tmp_path):
+    """The counterexample run (check_quorum=False): the same inbound
+    cut leaves the half-dead leader in charge for 4+ election timeouts
+    — its heartbeats suppress every follower timer, no higher term ever
+    reaches it, and a command submitted to it can never commit (the
+    quorum's acks are on the severed inbound path).  This is the
+    availability hole the tentpole closes; the artifact twin lives in
+    tools/chaos_run.py (--nemesis leader-isolate --no-check-quorum)."""
+    from rafting_tpu.core.types import EngineConfig as _EC, LEADER
+    from rafting_tpu.testkit.harness import LocalCluster
+
+    cfg = _EC(n_groups=3, n_peers=3, log_slots=64, batch=8, max_submit=8,
+              election_ticks=10, heartbeat_ticks=3, rpc_timeout_ticks=8,
+              read_lease=True, check_quorum=False)
+    cluster = LocalCluster(cfg, str(tmp_path), seed=13)
+    try:
+        for g in range(cfg.n_groups):
+            cluster.wait_leader(g)
+        lead = cluster.leader_of(1)
+        victim = cluster.nodes[lead]
+        elections0 = sum(n.metrics._counters.get("elections", 0)
+                         for n in cluster.nodes.values())
+        for o in range(cfg.n_peers):
+            if o != lead:
+                cluster.faults.set_link(o, lead, False)
+        fut = victim.submit(1, b"hostage-probe")
+        cluster.tick(4 * cfg.election_ticks)
+        assert cluster.leader_of(1) == lead, \
+            "leader lost the group without CheckQuorum (unexpected)"
+        # The probe must NOT commit.  It either hangs (no quorum ack can
+        # arrive on the severed inbound path) or the leader's quorum-
+        # health gate already refused it (NotReady: no healthy majority
+        # heard) — both are the unavailability; commitment would be the
+        # bug.  And no follower can take over either: their election
+        # timers are suppressed by the victim's still-flowing
+        # heartbeats, so they refuse with NotLeader pointing AT the
+        # hostage-taker.
+        if fut.done():
+            from rafting_tpu.api.anomaly import NotReadyError
+            assert isinstance(fut.exception(), NotReadyError), \
+                f"probe resolved oddly: {fut.exception()!r}"
+        for o in range(cfg.n_peers):
+            if o == lead:
+                continue
+            f2 = cluster.nodes[o].submit(1, b"follower-probe")
+            assert isinstance(f2.exception(), NotLeaderError)
+        elections1 = sum(n.metrics._counters.get("elections", 0)
+                         for n in cluster.nodes.values())
+        assert elections1 == elections0, \
+            "a follower re-elected despite suppressed timers"
+        # Heal and the world recovers — the hole is the WINDOW, which
+        # without CheckQuorum is unbounded (as long as the gray fault).
+        cluster.faults.heal()
+        cluster.net.flush_held()
+        probe = [None]
+
+        def committed():
+            if probe[0] is None and cluster.nodes[lead].is_ready(1):
+                probe[0] = cluster.nodes[lead].submit(1, b"post-heal")
+            return (probe[0] is not None and probe[0].done()
+                    and probe[0].exception() is None)
+        cluster.tick_until(committed, 800, "post-heal commit")
+    finally:
+        cluster.close()

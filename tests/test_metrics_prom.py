@@ -259,3 +259,73 @@ def test_hop_metric_cardinality_bounded(tmp_path, monkeypatch):
         validate_exposition(node.metrics.render_prometheus())
     finally:
         c.close()
+
+
+def test_self_healing_counters_on_exposition(tmp_path):
+    """ISSUE 20 satellite: the gray-failure plane's three counters —
+    checkquorum step-downs, leadership evacuations, lease vetoes — are
+    visible at ZERO from boot (an absent counter is indistinguishable
+    from a disabled plane to an alerting rule), round-trip the strict
+    validator, and the health gauges ride along when the plane is on.
+    Cardinality lint: the plane adds exactly 3 counters + 3 gauges —
+    nothing per-peer or per-group leaks into the registry."""
+    from rafting_tpu.core.types import EngineConfig
+    from rafting_tpu.testkit.harness import LocalCluster
+
+    cfg = EngineConfig(n_groups=2, n_peers=3, log_slots=16, batch=4,
+                       max_submit=4, election_ticks=6, heartbeat_ticks=2,
+                       rpc_timeout_ticks=5)
+    c = LocalCluster(cfg, str(tmp_path))
+    try:
+        c.wait_leader(0)
+        c.tick(3)
+        for node in c.nodes.values():
+            text = node.metrics.render_prometheus()
+            validate_exposition(text)
+            assert "raft_checkquorum_stepdowns_total 0" in text
+            assert "raft_leader_evacuations_total 0" in text
+            assert "raft_lease_vetoes_total 0" in text
+            # Health plane on by default: the three gauges exist.
+            assert node.health is not None
+            assert "raft_health_self_score" in text
+            assert "raft_health_self_degraded" in text
+            assert "raft_health_degraded_peers" in text
+            # Cardinality lint: one series per name, no per-peer fanout.
+            health_names = [n for n in node.metrics._counters
+                            if n in ("checkquorum_stepdowns",
+                                     "leader_evacuations",
+                                     "lease_vetoes")]
+            assert len(health_names) == 3
+            fanout = [n for n in list(node.metrics._counters)
+                      + list(node.metrics._gauges)
+                      if n.startswith("health_") and any(
+                          ch.isdigit() for ch in n)]
+            assert not fanout, f"per-entity health series leaked: {fanout}"
+    finally:
+        c.close()
+
+
+def test_health_disabled_suppresses_gauges(tmp_path, monkeypatch):
+    """RAFT_HEALTH=0 turns the scorecard plane off: no health gauges on
+    the page (the counters stay — device 6c still steps down), and the
+    node reports the plane disabled."""
+    from rafting_tpu.core.types import EngineConfig
+    from rafting_tpu.testkit.harness import LocalCluster
+
+    monkeypatch.setenv("RAFT_HEALTH", "0")
+    cfg = EngineConfig(n_groups=1, n_peers=3, log_slots=16, batch=4,
+                       max_submit=4, election_ticks=6, heartbeat_ticks=2,
+                       rpc_timeout_ticks=5)
+    c = LocalCluster(cfg, str(tmp_path))
+    try:
+        c.wait_leader(0)
+        c.tick(2)
+        node = c.nodes[c.leader_of(0)]
+        assert node.health is None
+        assert node.health_snapshot() == {"enabled": False}
+        text = node.metrics.render_prometheus()
+        validate_exposition(text)
+        assert "raft_checkquorum_stepdowns_total 0" in text
+        assert "raft_health_self_score" not in text
+    finally:
+        c.close()

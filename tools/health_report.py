@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Post-mortem gray-failure report over a health snapshot artifact.
+
+The live half of the self-healing plane is the ``peers`` block on
+``GET /healthz`` (runtime/obsrv.py); this CLI is the post-mortem half:
+a run that went gray saves its scorecards — a bare
+``node.health_snapshot()`` document, a full ``/healthz`` capture, or a
+``rafting_tpu.utils.tracelog.save_dump`` artifact with
+``meta={"health": node.health_snapshot()}`` — and this tool renders
+the story with no engine, device, or live process required (same
+contract as tools/hop_report.py):
+
+* the self scorecard: decayed score vs the degraded threshold;
+* the per-peer table: score, degraded flag, last-contact age (the
+  CheckQuorum lanes' view of who this node could actually HEAR);
+* the score timeline: per-sample rows showing WHEN each score crossed
+  the threshold — the minutes-before-the-page view;
+* the evacuation audit: which groups were handed where, at which tick.
+
+Usage:
+    tools/health_report.py SNAP.json[.gz] [--peer P] [--json]
+
+``--peer`` restricts the timeline columns to one peer (plus self).
+``--json`` re-emits the raw health document.
+"""
+
+import argparse
+import gzip
+import json
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+
+def _open_doc(path: str):
+    """Gzip-transparent read: .gz decompresses; a bare path falls back
+    to its .gz sibling when only the compressed form exists."""
+    if path.endswith(".gz"):
+        return gzip.open(path, "rt")
+    if not os.path.exists(path) and os.path.exists(path + ".gz"):
+        return gzip.open(path + ".gz", "rt")
+    return open(path)
+
+
+def extract_health(doc: dict):
+    """Find the health snapshot inside any of the accepted shapes:
+    a bare ``health_snapshot()``, a ``/healthz`` document (under
+    ``peers``), or a save_dump artifact (under ``_meta.health``).
+    Returns None when the document carries no scorecards (e.g. the
+    plane was disabled)."""
+    if not isinstance(doc, dict):
+        return None
+    if "self_score" in doc and "peers" in doc:
+        return doc
+    for key in ("peers", "health"):
+        inner = doc.get(key)
+        if isinstance(inner, dict) and "self_score" in inner:
+            return inner
+    meta = doc.get("_meta")
+    if isinstance(meta, dict):
+        return extract_health(meta)
+    return None
+
+
+def _bar(score: float, thr: float, width: int = 20) -> str:
+    """A threshold-relative score bar: full at 2x the degraded
+    threshold, '!' past it."""
+    full = max(thr * 2.0, 1e-9)
+    n = min(width, int(round(width * min(score, full) / full)))
+    mark = "!" if score >= thr else ""
+    return ("#" * n).ljust(width) + mark
+
+
+def render(health: dict, peer: int = None, out=None) -> None:
+    out = out if out is not None else sys.stdout
+    thr = float(health.get("degraded_after", 4.0))
+    print(f"health @ tick {health.get('tick', 0)}  "
+          f"half_life={health.get('half_life_ticks', 0):g} ticks  "
+          f"degraded_after={thr:g}", file=out)
+    flag = "DEGRADED" if health.get("self_degraded") else "healthy"
+    print(f"self: score={health.get('self_score', 0.0):g} [{flag}]",
+          file=out)
+    peers = health.get("peers") or []
+    if peers:
+        print("peers:", file=out)
+        for p in peers:
+            age = p.get("contact_age_ticks")
+            age_s = f"heard {age} ticks ago" if age is not None \
+                else "never heard"
+            tag = " DEGRADED" if p.get("degraded") else \
+                (" (self)" if p.get("self") else "")
+            print(f"  peer {p.get('peer'):<3d} "
+                  f"score={p.get('score', 0.0):<8g} "
+                  f"|{_bar(float(p.get('score', 0.0)), thr)}| "
+                  f"{age_s}{tag}", file=out)
+    timeline = health.get("timeline") or []
+    if timeline:
+        cols = ([peer] if peer is not None
+                else list(range(len(timeline[-1].get("peers") or []))))
+        head = "  ".join(f"p{c:<7d}" for c in cols)
+        print(f"timeline ({len(timeline)} samples):", file=out)
+        print(f"  {'tick':<8s} {'self':<8s} {head}", file=out)
+        for row in timeline:
+            scores = row.get("peers") or []
+            cells = "  ".join(
+                f"{scores[c]:<8g}" if c < len(scores) else f"{'-':<8s}"
+                for c in cols)
+            mark = "  <-- degraded" if row.get("self", 0.0) >= thr else ""
+            print(f"  {row.get('tick', 0):<8d} "
+                  f"{row.get('self', 0.0):<8g} {cells}{mark}", file=out)
+    evs = health.get("recent_evacuations") or []
+    print(f"evacuations: {health.get('evacuations', 0)}", file=out)
+    for e in evs:
+        print(f"  tick {e.get('tick'):<8d} group {e.get('group'):<5d} "
+              f"-> peer {e.get('target')}", file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("snap", help="health_snapshot / healthz / save_dump "
+                                 "document (.json or .json.gz)")
+    ap.add_argument("--peer", type=int, default=None,
+                    help="restrict timeline columns to one peer")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="re-emit the raw health document")
+    args = ap.parse_args(argv)
+
+    with _open_doc(args.snap) as f:
+        doc = json.load(f)
+    health = extract_health(doc)
+    if health is None:
+        print("no health scorecards in document (plane disabled, or "
+              "not a health/healthz/save_dump artifact)",
+              file=sys.stderr)
+        return 2
+    if args.as_json:
+        json.dump(health, sys.stdout, indent=2, sort_keys=True)
+        print()
+        return 0
+    render(health, peer=args.peer)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
